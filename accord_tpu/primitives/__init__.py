@@ -1,0 +1,14 @@
+from accord_tpu.primitives.timestamp import (
+    Timestamp, TxnId, Ballot, TxnKind, Domain, NodeId,
+)
+from accord_tpu.primitives.keyspace import Key, Keys, Range, Ranges
+from accord_tpu.primitives.routes import Route
+from accord_tpu.primitives.deps import KeyDeps, RangeDeps, Deps
+from accord_tpu.primitives.txn import Txn, PartialTxn
+from accord_tpu.primitives.writes import Writes
+
+__all__ = [
+    "Timestamp", "TxnId", "Ballot", "TxnKind", "Domain", "NodeId",
+    "Key", "Keys", "Range", "Ranges", "Route",
+    "KeyDeps", "RangeDeps", "Deps", "Txn", "PartialTxn", "Writes",
+]

@@ -1,0 +1,363 @@
+"""Dependency sets: the data the whole protocol revolves around.
+
+Role-equivalent to the reference's KeyDeps/RangeDeps/Deps (primitives/
+KeyDeps.java:51, RangeDeps.java:84, Deps.java:59): for each key (or range) a
+transaction touches, the set of earlier conflicting TxnIds it must wait for.
+
+Layout is CSR (compressed sparse row), same shape as the reference's
+RelationMultiMap flat-array encoding -- keys[], unique txn_ids[], offsets[],
+value_idx[] -- because CSR is simultaneously the mergeable host format and
+the tensor-friendly format the TPU deps kernels produce/consume
+(accord_tpu.ops.deps_resolver converts CSR <-> padded dense batches).
+"""
+from __future__ import annotations
+
+from bisect import bisect_left
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+from accord_tpu.primitives.keyspace import Key, Keys, Range, Ranges, Seekables
+from accord_tpu.primitives.timestamp import TxnId
+from accord_tpu.utils import sorted_arrays as sa
+
+
+class KeyDeps:
+    """key -> sorted set of TxnId, as CSR over sorted keys."""
+
+    __slots__ = ("keys", "txn_ids", "offsets", "value_idx")
+
+    def __init__(self, keys: Tuple[Key, ...], txn_ids: Tuple[TxnId, ...],
+                 offsets: Tuple[int, ...], value_idx: Tuple[int, ...]):
+        self.keys = keys            # sorted unique keys
+        self.txn_ids = txn_ids      # sorted unique txn ids (the dictionary)
+        self.offsets = offsets      # len(keys)+1 row offsets into value_idx
+        self.value_idx = value_idx  # indices into txn_ids, sorted per row
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def of(cls, mapping: Dict[Key, Iterable[TxnId]]) -> "KeyDeps":
+        b = KeyDepsBuilder()
+        for k, ids in mapping.items():
+            for t in ids:
+                b.add(k, t)
+        return b.build()
+
+    # -- queries -------------------------------------------------------------
+    def is_empty(self) -> bool:
+        return not self.keys
+
+    def key_count(self) -> int:
+        return len(self.keys)
+
+    def txn_id_count(self) -> int:
+        return len(self.txn_ids)
+
+    def for_key(self, key: Key) -> Tuple[TxnId, ...]:
+        i = bisect_left(self.keys, key)
+        if i >= len(self.keys) or self.keys[i] != key:
+            return ()
+        lo, hi = self.offsets[i], self.offsets[i + 1]
+        return tuple(self.txn_ids[v] for v in self.value_idx[lo:hi])
+
+    def participating_keys(self, txn_id: TxnId) -> Keys:
+        """Keys whose dep set includes txn_id (reference: participants())."""
+        i = sa.index_of(self.txn_ids, txn_id)
+        if i < 0:
+            return Keys.EMPTY
+        out = []
+        for row in range(len(self.keys)):
+            lo, hi = self.offsets[row], self.offsets[row + 1]
+            if sa.contains(self.value_idx[lo:hi], i):
+                out.append(self.keys[row])
+        return Keys(out)
+
+    def all_txn_ids(self) -> Tuple[TxnId, ...]:
+        return self.txn_ids
+
+    def contains(self, txn_id: TxnId) -> bool:
+        return sa.contains(self.txn_ids, txn_id)
+
+    def max_txn_id(self) -> Optional[TxnId]:
+        return self.txn_ids[-1] if self.txn_ids else None
+
+    def items(self) -> Iterator[Tuple[Key, Tuple[TxnId, ...]]]:
+        for i, k in enumerate(self.keys):
+            lo, hi = self.offsets[i], self.offsets[i + 1]
+            yield k, tuple(self.txn_ids[v] for v in self.value_idx[lo:hi])
+
+    # -- algebra -------------------------------------------------------------
+    def union(self, other: "KeyDeps") -> "KeyDeps":
+        if self.is_empty():
+            return other
+        if other.is_empty():
+            return self
+        b = KeyDepsBuilder()
+        for k, ids in self.items():
+            b.add_all(k, ids)
+        for k, ids in other.items():
+            b.add_all(k, ids)
+        return b.build()
+
+    def slice(self, ranges: Ranges) -> "KeyDeps":
+        if self.is_empty() or ranges.is_empty():
+            return KeyDeps.EMPTY
+        b = KeyDepsBuilder()
+        for k, ids in self.items():
+            if ranges.contains_key(k):
+                b.add_all(k, ids)
+        return b.build()
+
+    def without(self, pred: Callable[[TxnId], bool]) -> "KeyDeps":
+        """Drop every txn_id for which pred is true."""
+        b = KeyDepsBuilder()
+        for k, ids in self.items():
+            kept = [t for t in ids if not pred(t)]
+            if kept:
+                b.add_all(k, kept)
+        return b.build()
+
+    @staticmethod
+    def merge(many: Sequence["KeyDeps"]) -> "KeyDeps":
+        b = KeyDepsBuilder()
+        for kd in many:
+            for k, ids in kd.items():
+                b.add_all(k, ids)
+        return b.build()
+
+    def __eq__(self, other):
+        return (isinstance(other, KeyDeps) and self.keys == other.keys
+                and self.txn_ids == other.txn_ids and self.offsets == other.offsets
+                and self.value_idx == other.value_idx)
+
+    def __hash__(self):
+        return hash((self.keys, self.txn_ids))
+
+    def __repr__(self):
+        return "KeyDeps{" + ", ".join(f"{k}: {list(v)}" for k, v in self.items()) + "}"
+
+
+class KeyDepsBuilder:
+    __slots__ = ("_map",)
+
+    def __init__(self):
+        self._map: Dict[Key, set] = {}
+
+    def add(self, key: Key, txn_id: TxnId) -> "KeyDepsBuilder":
+        self._map.setdefault(key, set()).add(txn_id)
+        return self
+
+    def add_all(self, key: Key, txn_ids: Iterable[TxnId]) -> "KeyDepsBuilder":
+        self._map.setdefault(key, set()).update(txn_ids)
+        return self
+
+    def build(self) -> KeyDeps:
+        if not self._map:
+            return KeyDeps.EMPTY
+        keys = tuple(sorted(self._map))
+        uniq = sorted(set().union(*self._map.values()))
+        txn_ids = tuple(uniq)
+        index = {t: i for i, t in enumerate(uniq)}
+        offsets = [0]
+        value_idx: List[int] = []
+        for k in keys:
+            row = sorted(index[t] for t in self._map[k])
+            value_idx.extend(row)
+            offsets.append(len(value_idx))
+        return KeyDeps(keys, txn_ids, tuple(offsets), tuple(value_idx))
+
+
+KeyDeps.EMPTY = KeyDeps((), (), (0,), ())
+
+
+class RangeDeps:
+    """range -> sorted set of TxnId. Linear-scan interval queries for now; the
+    reference accelerates this with a checkpointed interval index
+    (SearchableRangeList, utils/SearchableRangeList.java) and the TPU path
+    will use interval bitmaps -- both are internal representations behind the
+    same query surface."""
+
+    __slots__ = ("ranges", "txn_ids", "offsets", "value_idx")
+
+    def __init__(self, ranges: Tuple[Range, ...], txn_ids: Tuple[TxnId, ...],
+                 offsets: Tuple[int, ...], value_idx: Tuple[int, ...]):
+        self.ranges = ranges
+        self.txn_ids = txn_ids
+        self.offsets = offsets
+        self.value_idx = value_idx
+
+    @classmethod
+    def of(cls, mapping: Dict[Range, Iterable[TxnId]]) -> "RangeDeps":
+        b = RangeDepsBuilder()
+        for r, ids in mapping.items():
+            b.add_all(r, ids)
+        return b.build()
+
+    def is_empty(self) -> bool:
+        return not self.ranges
+
+    def items(self) -> Iterator[Tuple[Range, Tuple[TxnId, ...]]]:
+        for i, r in enumerate(self.ranges):
+            lo, hi = self.offsets[i], self.offsets[i + 1]
+            yield r, tuple(self.txn_ids[v] for v in self.value_idx[lo:hi])
+
+    def for_key(self, key: Key) -> Tuple[TxnId, ...]:
+        out: set = set()
+        for r, ids in self.items():
+            if r.contains(key):
+                out.update(ids)
+        return tuple(sorted(out))
+
+    def intersecting(self, target: Range) -> Tuple[TxnId, ...]:
+        out: set = set()
+        for r, ids in self.items():
+            if r.intersects(target):
+                out.update(ids)
+        return tuple(sorted(out))
+
+    def all_txn_ids(self) -> Tuple[TxnId, ...]:
+        return self.txn_ids
+
+    def contains(self, txn_id: TxnId) -> bool:
+        return sa.contains(self.txn_ids, txn_id)
+
+    def max_txn_id(self) -> Optional[TxnId]:
+        return self.txn_ids[-1] if self.txn_ids else None
+
+    def union(self, other: "RangeDeps") -> "RangeDeps":
+        if self.is_empty():
+            return other
+        if other.is_empty():
+            return self
+        b = RangeDepsBuilder()
+        for r, ids in self.items():
+            b.add_all(r, ids)
+        for r, ids in other.items():
+            b.add_all(r, ids)
+        return b.build()
+
+    def slice(self, window: Ranges) -> "RangeDeps":
+        if self.is_empty() or window.is_empty():
+            return RangeDeps.EMPTY
+        b = RangeDepsBuilder()
+        for r, ids in self.items():
+            for w in window:
+                x = r.intersection(w)
+                if x is not None:
+                    b.add_all(x, ids)
+        return b.build()
+
+    def without(self, pred: Callable[[TxnId], bool]) -> "RangeDeps":
+        b = RangeDepsBuilder()
+        for r, ids in self.items():
+            kept = [t for t in ids if not pred(t)]
+            if kept:
+                b.add_all(r, kept)
+        return b.build()
+
+    @staticmethod
+    def merge(many: Sequence["RangeDeps"]) -> "RangeDeps":
+        b = RangeDepsBuilder()
+        for rd in many:
+            for r, ids in rd.items():
+                b.add_all(r, ids)
+        return b.build()
+
+    def __eq__(self, other):
+        return (isinstance(other, RangeDeps) and self.ranges == other.ranges
+                and self.txn_ids == other.txn_ids and self.offsets == other.offsets
+                and self.value_idx == other.value_idx)
+
+    def __hash__(self):
+        return hash((self.ranges, self.txn_ids))
+
+    def __repr__(self):
+        return "RangeDeps{" + ", ".join(f"{r}: {list(v)}" for r, v in self.items()) + "}"
+
+
+class RangeDepsBuilder:
+    __slots__ = ("_map",)
+
+    def __init__(self):
+        self._map: Dict[Range, set] = {}
+
+    def add(self, rng: Range, txn_id: TxnId) -> "RangeDepsBuilder":
+        self._map.setdefault(rng, set()).add(txn_id)
+        return self
+
+    def add_all(self, rng: Range, txn_ids: Iterable[TxnId]) -> "RangeDepsBuilder":
+        self._map.setdefault(rng, set()).update(txn_ids)
+        return self
+
+    def build(self) -> RangeDeps:
+        if not self._map:
+            return RangeDeps.EMPTY
+        ranges = tuple(sorted(self._map))
+        uniq = sorted(set().union(*self._map.values()))
+        txn_ids = tuple(uniq)
+        index = {t: i for i, t in enumerate(uniq)}
+        offsets = [0]
+        value_idx: List[int] = []
+        for r in ranges:
+            row = sorted(index[t] for t in self._map[r])
+            value_idx.extend(row)
+            offsets.append(len(value_idx))
+        return RangeDeps(ranges, txn_ids, tuple(offsets), tuple(value_idx))
+
+
+RangeDeps.EMPTY = RangeDeps((), (), (0,), ())
+
+
+class Deps:
+    """KeyDeps + RangeDeps pair (reference: primitives/Deps.java:59; we fold
+    the reference's third `directKeyDeps` component into key_deps -- it exists
+    there only to optimize range-txn handling below a boundary)."""
+
+    __slots__ = ("key_deps", "range_deps")
+
+    def __init__(self, key_deps: KeyDeps = KeyDeps.EMPTY,
+                 range_deps: RangeDeps = RangeDeps.EMPTY):
+        self.key_deps = key_deps
+        self.range_deps = range_deps
+
+    def is_empty(self) -> bool:
+        return self.key_deps.is_empty() and self.range_deps.is_empty()
+
+    def for_key(self, key: Key) -> Tuple[TxnId, ...]:
+        return tuple(sorted(set(self.key_deps.for_key(key)) | set(self.range_deps.for_key(key))))
+
+    def all_txn_ids(self) -> Tuple[TxnId, ...]:
+        return sa.linear_union(self.key_deps.all_txn_ids(), self.range_deps.all_txn_ids())
+
+    def contains(self, txn_id: TxnId) -> bool:
+        return self.key_deps.contains(txn_id) or self.range_deps.contains(txn_id)
+
+    def max_txn_id(self) -> Optional[TxnId]:
+        from accord_tpu.primitives.timestamp import Timestamp
+        return Timestamp.merge_max(self.key_deps.max_txn_id(), self.range_deps.max_txn_id())
+
+    def union(self, other: "Deps") -> "Deps":
+        return Deps(self.key_deps.union(other.key_deps),
+                    self.range_deps.union(other.range_deps))
+
+    def slice(self, ranges: Ranges) -> "Deps":
+        return Deps(self.key_deps.slice(ranges), self.range_deps.slice(ranges))
+
+    def without(self, pred: Callable[[TxnId], bool]) -> "Deps":
+        return Deps(self.key_deps.without(pred), self.range_deps.without(pred))
+
+    @staticmethod
+    def merge(many: Sequence["Deps"]) -> "Deps":
+        return Deps(KeyDeps.merge([d.key_deps for d in many]),
+                    RangeDeps.merge([d.range_deps for d in many]))
+
+    def __eq__(self, other):
+        return (isinstance(other, Deps) and self.key_deps == other.key_deps
+                and self.range_deps == other.range_deps)
+
+    def __hash__(self):
+        return hash((self.key_deps, self.range_deps))
+
+    def __repr__(self):
+        return f"Deps({self.key_deps!r}, {self.range_deps!r})"
+
+
+Deps.NONE = Deps()

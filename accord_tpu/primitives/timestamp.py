@@ -1,0 +1,235 @@
+"""Protocol timestamps: Timestamp, TxnId, Ballot.
+
+Role-equivalent to the reference's hybrid-logical-clock value types
+(primitives/Timestamp.java:28-90, TxnId.java:33, Ballot.java): a globally
+unique, roughly-time-ordered identifier. Total order is (epoch, hlc, flags,
+node) -- node id breaks ties deterministically, which is what makes the whole
+protocol (and the burn test's replayability) deterministic.
+
+TPU-first encoding: every timestamp packs losslessly into two int64 lanes
+(msb = epoch<<16 | flags, lsb = hlc<<16 | node), the struct-of-arrays layout
+consumed by the device deps kernels (accord_tpu.ops). The reference uses the
+same two-long packing; here it is the *tensor* layout, not a memory trick.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Optional, Tuple
+
+# Node ids are small ints (reference: Node.Id, local/Node.java:104).
+NodeId = int
+
+_FLAGS_BITS = 16
+_NODE_BITS = 16
+_HLC_BITS = 48
+_EPOCH_BITS = 48
+
+# Flag layout inside the 16-bit flags field (TxnId only; plain Timestamps and
+# Ballots carry flags == 0 unless REJECTED):
+#   bits 0..2  TxnKind ordinal
+#   bit  3     Domain (0 = Key, 1 = Range)
+_KIND_MASK = 0x7
+_DOMAIN_SHIFT = 3
+REJECTED_FLAG = 1 << 15  # mirrors Timestamp.REJECTED (used by PreAccept nacks)
+
+
+class Domain(enum.IntEnum):
+    KEY = 0
+    RANGE = 1
+
+
+class TxnKind(enum.IntEnum):
+    """Transaction kinds and their conflict-witnessing rules (reference:
+    primitives/Txn.java:53 Kind / :125 Kinds)."""
+
+    READ = 0
+    WRITE = 1
+    EPHEMERAL_READ = 2
+    SYNC_POINT = 3
+    EXCLUSIVE_SYNC_POINT = 4
+    LOCAL_ONLY = 5
+
+    def witnesses(self, other: "TxnKind") -> bool:
+        """Does a txn of kind `self` include a conflicting txn of kind `other`
+        in its deps? Reads witness only writes; writes and sync points witness
+        reads and writes."""
+        w = _WITNESSES[self]
+        return other in w
+
+    def witnessed_by(self, other: "TxnKind") -> bool:
+        return self in _WITNESSES[other]
+
+    @property
+    def is_write(self) -> bool:
+        return self is TxnKind.WRITE or self is TxnKind.EXCLUSIVE_SYNC_POINT
+
+    @property
+    def is_read(self) -> bool:
+        return self in (TxnKind.READ, TxnKind.EPHEMERAL_READ)
+
+    @property
+    def is_sync_point(self) -> bool:
+        return self in (TxnKind.SYNC_POINT, TxnKind.EXCLUSIVE_SYNC_POINT)
+
+    @property
+    def is_durable(self) -> bool:
+        """Ephemeral reads leave no durable state."""
+        return self is not TxnKind.EPHEMERAL_READ
+
+
+_RW = frozenset({TxnKind.READ, TxnKind.WRITE, TxnKind.SYNC_POINT,
+                 TxnKind.EXCLUSIVE_SYNC_POINT})
+_W = frozenset({TxnKind.WRITE, TxnKind.EXCLUSIVE_SYNC_POINT})
+_WITNESSES = {
+    TxnKind.READ: _W,
+    TxnKind.EPHEMERAL_READ: _W,
+    TxnKind.WRITE: _RW,
+    TxnKind.SYNC_POINT: _RW,
+    TxnKind.EXCLUSIVE_SYNC_POINT: _RW,
+    TxnKind.LOCAL_ONLY: frozenset(),
+}
+
+
+class Timestamp:
+    """(epoch, hlc, flags, node) with total order. Immutable."""
+
+    __slots__ = ("epoch", "hlc", "flags", "node")
+
+    def __init__(self, epoch: int, hlc: int, flags: int, node: NodeId):
+        assert 0 <= epoch < (1 << _EPOCH_BITS)
+        assert 0 <= hlc < (1 << _HLC_BITS)
+        assert 0 <= flags < (1 << _FLAGS_BITS)
+        assert 0 <= node < (1 << _NODE_BITS)
+        object.__setattr__(self, "epoch", epoch)
+        object.__setattr__(self, "hlc", hlc)
+        object.__setattr__(self, "flags", flags)
+        object.__setattr__(self, "node", node)
+
+    def __setattr__(self, *a):
+        raise AttributeError("immutable")
+
+    # -- ordering ------------------------------------------------------------
+    def _key(self) -> Tuple[int, int, int, int]:
+        return (self.epoch, self.hlc, self.flags, self.node)
+
+    def __lt__(self, other: "Timestamp") -> bool:
+        return self._key() < other._key()
+
+    def __le__(self, other: "Timestamp") -> bool:
+        return self._key() <= other._key()
+
+    def __gt__(self, other: "Timestamp") -> bool:
+        return self._key() > other._key()
+
+    def __ge__(self, other: "Timestamp") -> bool:
+        return self._key() >= other._key()
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Timestamp) and self._key() == other._key()
+
+    def __hash__(self) -> int:
+        return hash(self._key())
+
+    # -- derivation ----------------------------------------------------------
+    def with_next_hlc(self) -> "Timestamp":
+        return Timestamp(self.epoch, self.hlc + 1, 0, self.node)
+
+    def with_node(self, node: NodeId) -> "Timestamp":
+        return Timestamp(self.epoch, self.hlc, self.flags, node)
+
+    def with_epoch_at_least(self, epoch: int) -> "Timestamp":
+        return self if self.epoch >= epoch else Timestamp(epoch, self.hlc, self.flags, self.node)
+
+    @staticmethod
+    def merge_max(a: Optional["Timestamp"], b: Optional["Timestamp"]) -> Optional["Timestamp"]:
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return a if a >= b else b
+
+    # -- tensor encoding -----------------------------------------------------
+    def pack(self) -> Tuple[int, int]:
+        """(msb, lsb) int64 pair, order-preserving when compared as unsigned
+        (msb, lsb) pairs -- the struct-of-arrays layout the device kernels use.
+        msb = epoch(48) . hlc_hi(16); lsb = hlc_lo(32) . flags(16) . node(16)."""
+        msb = (self.epoch << 16) | (self.hlc >> 32)
+        lsb = ((self.hlc & 0xFFFFFFFF) << 32) | (self.flags << 16) | self.node
+        return msb, lsb
+
+    @classmethod
+    def unpack(cls, msb: int, lsb: int) -> "Timestamp":
+        epoch = msb >> 16
+        hlc = ((msb & 0xFFFF) << 32) | (lsb >> 32)
+        return cls(epoch, hlc, (lsb >> 16) & 0xFFFF, lsb & 0xFFFF)
+
+    def __repr__(self):
+        return f"[{self.epoch},{self.hlc},{self.flags},{self.node}]"
+
+
+Timestamp.NONE = Timestamp(0, 0, 0, 0)
+Timestamp.MAX = Timestamp((1 << _EPOCH_BITS) - 1, (1 << _HLC_BITS) - 1, (1 << _FLAGS_BITS) - 1, (1 << _NODE_BITS) - 1)
+
+
+class TxnId(Timestamp):
+    """Timestamp whose flags encode TxnKind + Domain (reference:
+    primitives/TxnId.java:81-99)."""
+
+    __slots__ = ()
+
+    def __init__(self, epoch: int, hlc: int, flags: int, node: NodeId):
+        super().__init__(epoch, hlc, flags, node)
+
+    @classmethod
+    def create(cls, epoch: int, hlc: int, node: NodeId, kind: TxnKind,
+               domain: Domain = Domain.KEY) -> "TxnId":
+        flags = int(kind) | (int(domain) << _DOMAIN_SHIFT)
+        return cls(epoch, hlc, flags, node)
+
+    @property
+    def kind(self) -> TxnKind:
+        return TxnKind(self.flags & _KIND_MASK)
+
+    @property
+    def domain(self) -> Domain:
+        return Domain((self.flags >> _DOMAIN_SHIFT) & 1)
+
+    @property
+    def is_write(self) -> bool:
+        return self.kind.is_write
+
+    @property
+    def is_read(self) -> bool:
+        return self.kind.is_read
+
+    def witnesses(self, other: "TxnId") -> bool:
+        return self.kind.witnesses(other.kind)
+
+    def as_timestamp(self) -> Timestamp:
+        return Timestamp(self.epoch, self.hlc, self.flags, self.node)
+
+    @classmethod
+    def from_timestamp(cls, ts: Timestamp) -> "TxnId":
+        return cls(ts.epoch, ts.hlc, ts.flags, ts.node)
+
+    def __repr__(self):
+        return f"{self.kind.name[0]}{'r' if self.domain == Domain.RANGE else ''}[{self.epoch},{self.hlc},{self.node}]"
+
+
+TxnId.NONE = TxnId(0, 0, 0, 0)
+TxnId.MAX = TxnId.from_timestamp(Timestamp.MAX)
+
+
+class Ballot(Timestamp):
+    """Paxos-style promise token used by Accept and Recovery rounds
+    (reference: primitives/Ballot.java)."""
+
+    __slots__ = ()
+
+    @classmethod
+    def from_timestamp(cls, ts: Timestamp) -> "Ballot":
+        return cls(ts.epoch, ts.hlc, ts.flags, ts.node)
+
+
+Ballot.ZERO = Ballot(0, 0, 0, 0)
+Ballot.MAX = Ballot.from_timestamp(Timestamp.MAX)

@@ -1,0 +1,8 @@
+from accord_tpu.utils.invariants import Invariants, IllegalState, IllegalArgument
+from accord_tpu.utils.rng import RandomSource
+from accord_tpu.utils.async_ import AsyncResult, AsyncChain, settable
+
+__all__ = [
+    "Invariants", "IllegalState", "IllegalArgument", "RandomSource",
+    "AsyncResult", "AsyncChain", "settable",
+]

@@ -1,0 +1,143 @@
+"""Immutable range->value maps with merge(reduce) semantics.
+
+Role-equivalent to the reference's ReducingIntervalMap/ReducingRangeMap
+(utils/ReducingRangeMap.java), which underlie RedundantBefore, DurableBefore,
+MaxConflicts and LatestDeps. Representation: sorted boundary keys b0<..<bn and
+values v0..v(n-1), where values[i] covers the half-open interval
+[bounds[i], bounds[i+1]). Keys outside all intervals map to None.
+"""
+from __future__ import annotations
+
+from bisect import bisect_right
+from typing import Any, Callable, Generic, Iterable, List, Optional, Tuple, TypeVar
+
+V = TypeVar("V")
+
+
+class ReducingRangeMap(Generic[V]):
+    __slots__ = ("bounds", "values")
+
+    EMPTY: "ReducingRangeMap"
+
+    def __init__(self, bounds: Tuple[Any, ...] = (), values: Tuple[Optional[V], ...] = ()):
+        assert len(bounds) == 0 or len(values) == len(bounds) - 1
+        assert all(bounds[i] < bounds[i + 1] for i in range(len(bounds) - 1))
+        self.bounds = bounds
+        self.values = values
+
+    def is_empty(self) -> bool:
+        return not self.bounds
+
+    def get(self, key) -> Optional[V]:
+        """Value covering key, or None."""
+        if not self.bounds:
+            return None
+        i = bisect_right(self.bounds, key) - 1
+        if i < 0 or i >= len(self.values):
+            return None
+        return self.values[i]
+
+    def fold_over_range(self, start, end, fn: Callable[[Optional[V], Any], Any], acc):
+        """fold fn(acc, value) over every value segment intersecting [start, end)."""
+        if not self.bounds or start >= end:
+            return acc
+        i = max(0, bisect_right(self.bounds, start) - 1)
+        while i < len(self.values):
+            seg_start = self.bounds[i]
+            seg_end = self.bounds[i + 1]
+            if seg_start >= end:
+                break
+            if seg_end > start and self.values[i] is not None:
+                acc = fn(acc, self.values[i])
+            i += 1
+        return acc
+
+    def fold_values(self, fn: Callable[[Any, V], Any], acc):
+        for v in self.values:
+            if v is not None:
+                acc = fn(acc, v)
+        return acc
+
+    def segments(self) -> Iterable[Tuple[Any, Any, Optional[V]]]:
+        for i, v in enumerate(self.values):
+            yield self.bounds[i], self.bounds[i + 1], v
+
+    def with_range(self, start, end, value: V, reduce: Callable[[V, V], V]) -> "ReducingRangeMap[V]":
+        """Merge `value` into [start, end): existing segments inside the window
+        get reduce(old, value); uncovered gaps get `value`."""
+        if start >= end:
+            return self
+        return merge(self, ReducingRangeMap((start, end), (value,)), reduce)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ReducingRangeMap)
+            and self.bounds == other.bounds
+            and self.values == other.values
+        )
+
+    def __hash__(self):
+        return hash((self.bounds, self.values))
+
+    def __repr__(self):
+        segs = ", ".join(f"[{s},{e}):{v!r}" for s, e, v in self.segments())
+        return f"RangeMap({segs})"
+
+
+ReducingRangeMap.EMPTY = ReducingRangeMap()
+
+
+def merge(a: ReducingRangeMap, b: ReducingRangeMap, reduce: Callable) -> ReducingRangeMap:
+    """Merge two maps; overlapping segments combine with reduce(av, bv)."""
+    if a.is_empty():
+        return b
+    if b.is_empty():
+        return a
+    # Sweep over the union of boundary points.
+    points: List[Any] = sorted(set(a.bounds) | set(b.bounds))
+    bounds: List[Any] = []
+    values: List[Any] = []
+    for i in range(len(points) - 1):
+        lo = points[i]
+        av = a.get(lo)
+        bv = b.get(lo)
+        if av is None:
+            v = bv
+        elif bv is None:
+            v = av
+        else:
+            v = reduce(av, bv)
+        bounds.append(lo)
+        values.append(v)
+    bounds.append(points[-1])
+    # Normalize: drop leading/trailing None segments, merge equal neighbours.
+    return _normalize(bounds, values)
+
+
+def _normalize(bounds: List[Any], values: List[Any]) -> ReducingRangeMap:
+    nb: List[Any] = []
+    nv: List[Any] = []
+    for i, v in enumerate(values):
+        if nv and nv[-1] == v:
+            continue  # extend previous segment; skip boundary
+        # close previous segment at bounds[i] implicitly by starting new one
+        if nv or v is not None:
+            if not nb:
+                if v is None:
+                    continue
+                nb.append(bounds[i])
+                nv.append(v)
+            else:
+                nb.append(bounds[i])
+                nv.append(v)
+        # else: still leading Nones, skip
+    if not nv:
+        return ReducingRangeMap.EMPTY
+    # find the end bound: last segment with non-None value
+    last_non_none = max(i for i, v in enumerate(values) if v is not None)
+    nb.append(bounds[last_non_none + 1])
+    # strip trailing None value segments from nv/nb
+    while nv and nv[-1] is None:
+        nv.pop()
+        nb.pop(-2)
+    return ReducingRangeMap(tuple(nb), tuple(nv))

@@ -1,0 +1,69 @@
+import random
+
+from accord_tpu.primitives import Deps, KeyDeps, RangeDeps, Range, Ranges, TxnId, TxnKind
+from accord_tpu.primitives.deps import KeyDepsBuilder, RangeDepsBuilder
+
+
+def tid(hlc, node=1, kind=TxnKind.WRITE):
+    return TxnId.create(1, hlc, node, kind)
+
+
+def test_keydeps_builder_csr():
+    kd = KeyDeps.of({1: [tid(3), tid(1)], 5: [tid(1)]})
+    assert kd.for_key(1) == (tid(1), tid(3))
+    assert kd.for_key(5) == (tid(1),)
+    assert kd.for_key(9) == ()
+    assert kd.all_txn_ids() == (tid(1), tid(3))
+    assert kd.contains(tid(3)) and not kd.contains(tid(9))
+    assert kd.max_txn_id() == tid(3)
+    assert kd.participating_keys(tid(1)).as_tuple() == (1, 5)
+    assert kd.participating_keys(tid(3)).as_tuple() == (1,)
+
+
+def test_keydeps_union_slice_without():
+    a = KeyDeps.of({1: [tid(1)], 2: [tid(2)]})
+    b = KeyDeps.of({2: [tid(3)], 4: [tid(4)]})
+    u = a.union(b)
+    assert u.for_key(2) == (tid(2), tid(3))
+    s = u.slice(Ranges.of(Range(2, 5)))
+    assert s.for_key(1) == () and s.for_key(2) == (tid(2), tid(3))
+    w = u.without(lambda t: t.hlc <= 2)
+    assert w.for_key(1) == () and w.for_key(2) == (tid(3),)
+
+
+def test_keydeps_randomized_vs_naive():
+    rng = random.Random(7)
+    for _ in range(30):
+        naive = {}
+        b = KeyDepsBuilder()
+        for _ in range(rng.randrange(0, 60)):
+            k = rng.randrange(8)
+            t = tid(rng.randrange(20), rng.randrange(3))
+            naive.setdefault(k, set()).add(t)
+            b.add(k, t)
+        kd = b.build()
+        for k in range(8):
+            assert kd.for_key(k) == tuple(sorted(naive.get(k, set())))
+        assert kd.all_txn_ids() == tuple(sorted(set().union(*naive.values()) if naive else set()))
+
+
+def test_rangedeps():
+    rd = RangeDeps.of({Range(0, 10): [tid(1)], Range(5, 15): [tid(2)]})
+    assert rd.for_key(7) == (tid(1), tid(2))
+    assert rd.for_key(12) == (tid(2),)
+    assert rd.intersecting(Range(14, 20)) == (tid(2),)
+    assert rd.intersecting(Range(20, 30)) == ()
+    s = rd.slice(Ranges.of(Range(0, 6)))
+    assert s.for_key(12) == ()
+    assert s.for_key(3) == (tid(1),)
+
+
+def test_deps_merge():
+    d1 = Deps(KeyDeps.of({1: [tid(1)]}), RangeDeps.of({Range(0, 5): [tid(2)]}))
+    d2 = Deps(KeyDeps.of({1: [tid(3)]}))
+    m = Deps.merge([d1, d2])
+    assert m.for_key(1) == (tid(1), tid(2), tid(3))
+    assert m.contains(tid(2))
+    assert m.max_txn_id() == tid(3)
+    assert not m.is_empty()
+    assert Deps.NONE.is_empty()

@@ -1,0 +1,63 @@
+from accord_tpu.primitives import Ballot, Domain, Timestamp, TxnId, TxnKind
+
+
+def test_total_order():
+    a = Timestamp(1, 5, 0, 1)
+    b = Timestamp(1, 5, 0, 2)
+    c = Timestamp(1, 6, 0, 1)
+    d = Timestamp(2, 0, 0, 0)
+    assert a < b < c < d
+    assert max(a, b, c, d) == d
+    assert Timestamp.merge_max(a, c) == c
+    assert Timestamp.merge_max(None, a) == a
+    assert Timestamp.merge_max(a, None) == a
+
+
+def test_pack_unpack_roundtrip():
+    for ts in [Timestamp(0, 0, 0, 0), Timestamp(3, 123456789, 7, 42),
+               Timestamp((1 << 48) - 1, (1 << 48) - 1, (1 << 16) - 1, (1 << 16) - 1)]:
+        msb, lsb = ts.pack()
+        assert Timestamp.unpack(msb, lsb) == ts
+
+
+def test_pack_order_preserving():
+    import random
+    rng = random.Random(0)
+    tss = [Timestamp(rng.randrange(4), rng.randrange(100), rng.randrange(4), rng.randrange(8))
+           for _ in range(200)]
+    by_value = sorted(tss)
+    by_packed = sorted(tss, key=lambda t: t.pack())
+    assert by_value == by_packed
+
+
+def test_txnid_kind_domain():
+    t = TxnId.create(epoch=2, hlc=99, node=3, kind=TxnKind.WRITE, domain=Domain.RANGE)
+    assert t.kind == TxnKind.WRITE
+    assert t.domain == Domain.RANGE
+    assert t.is_write
+    r = TxnId.create(1, 1, 1, TxnKind.READ)
+    assert r.kind == TxnKind.READ and r.domain == Domain.KEY and r.is_read
+
+
+def test_witness_rules():
+    R, W = TxnKind.READ, TxnKind.WRITE
+    SP, XSP = TxnKind.SYNC_POINT, TxnKind.EXCLUSIVE_SYNC_POINT
+    assert R.witnesses(W) and not R.witnesses(R)
+    assert W.witnesses(R) and W.witnesses(W)
+    assert SP.witnesses(R) and SP.witnesses(W)
+    assert XSP.witnesses(W)
+    assert not R.witnesses(SP)
+    assert W.witnessed_by(R)
+
+
+def test_ballot():
+    assert Ballot.ZERO < Ballot(1, 0, 0, 0) < Ballot.MAX
+    assert isinstance(Ballot.ZERO, Timestamp)
+
+
+def test_hlc_derivation():
+    t = Timestamp(1, 10, 0, 3)
+    n = t.with_next_hlc()
+    assert n.hlc == 11 and n.epoch == 1
+    assert t.with_epoch_at_least(5).epoch == 5
+    assert t.with_epoch_at_least(0) is t

@@ -1,0 +1,104 @@
+import random
+
+import pytest
+
+from accord_tpu.utils import sorted_arrays as sa
+from accord_tpu.utils.async_ import AsyncResult, all_of, failure, settable, success
+from accord_tpu.utils.range_map import ReducingRangeMap, merge
+from accord_tpu.utils.rng import RandomSource
+
+
+def test_sorted_arrays():
+    assert sa.linear_union((1, 3), (2, 3, 4)) == (1, 2, 3, 4)
+    assert sa.linear_intersection((1, 3, 5), (3, 4, 5)) == (3, 5)
+    assert sa.linear_difference((1, 2, 3), (2,)) == (1, 3)
+    assert sa.contains((1, 3), 3) and not sa.contains((1, 3), 2)
+    assert sa.index_of((1, 3, 5), 3) == 1
+    assert sa.index_of((1, 3, 5), 4) == -3
+    assert sa.insert((1, 3), 2) == (1, 2, 3)
+    assert sa.insert((1, 3), 3) == (1, 3)
+    assert sa.remove((1, 2, 3), 2) == (1, 3)
+    assert sa.next_intersection((1, 5, 9), 0, (2, 5, 9), 0) == (1, 1)
+    assert sa.next_intersection((1, 5, 9), 2, (2, 5), 0) is None
+    # union fast-path identity
+    a = (1, 2, 3)
+    assert sa.linear_union(a, (2,)) == a
+
+
+def test_async_basics():
+    r = settable()
+    seen = []
+    r.map(lambda v: v + 1).on_success(seen.append)
+    r.set_success(1)
+    assert seen == [2]
+    assert r.done and r.success and r.value() == 1
+
+    f = failure(ValueError("x"))
+    got = []
+    f.on_failure(lambda e: got.append(type(e)))
+    assert got == [ValueError]
+    with pytest.raises(ValueError):
+        f.value()
+
+
+def test_async_flatmap_and_all():
+    a, b = settable(), settable()
+    combined = all_of([a, b])
+    a.set_success(1)
+    assert not combined.done
+    b.set_success(2)
+    assert combined.value() == [1, 2]
+
+    chained = success(5).flat_map(lambda v: success(v * 2))
+    assert chained.value() == 10
+
+    # failure fast-path in all_of
+    c, d = settable(), settable()
+    comb2 = all_of([c, d])
+    c.set_failure(RuntimeError("boom"))
+    assert comb2.done and not comb2.success
+
+
+def test_rng_determinism():
+    a, b = RandomSource(123), RandomSource(123)
+    assert [a.next_int(100) for _ in range(20)] == [b.next_int(100) for _ in range(20)]
+    fa, fb = a.fork(), b.fork()
+    assert [fa.next_long() for _ in range(5)] == [fb.next_long() for _ in range(5)]
+    z = RandomSource(1)
+    vals = [z.zipf(10) for _ in range(200)]
+    assert all(0 <= v < 10 for v in vals)
+    # hot head: rank 0 should dominate
+    assert vals.count(0) > vals.count(9)
+
+
+def test_range_map_basic():
+    m = ReducingRangeMap.EMPTY.with_range(0, 10, 5, max)
+    assert m.get(0) == 5 and m.get(9) == 5 and m.get(10) is None and m.get(-1) is None
+    m2 = m.with_range(5, 15, 7, max)
+    assert m2.get(3) == 5 and m2.get(6) == 7 and m2.get(12) == 7 and m2.get(15) is None
+    m3 = m2.with_range(0, 20, 6, max)
+    assert m3.get(3) == 6 and m3.get(6) == 7 and m3.get(16) == 6
+
+
+def test_range_map_randomized_vs_naive():
+    rng = random.Random(9)
+    for _ in range(40):
+        m = ReducingRangeMap.EMPTY
+        naive = {}
+        for _ in range(rng.randrange(1, 10)):
+            s = rng.randrange(0, 40)
+            e = s + rng.randrange(1, 12)
+            v = rng.randrange(100)
+            m = m.with_range(s, e, v, max)
+            for x in range(s, e):
+                naive[x] = max(naive.get(x, v), v)
+        for x in range(-2, 60):
+            assert m.get(x) == naive.get(x), f"key {x}: {m} vs {naive.get(x)}"
+
+
+def test_range_map_fold():
+    m = ReducingRangeMap.EMPTY.with_range(0, 10, 1, max).with_range(20, 30, 2, max)
+    total = m.fold_over_range(5, 25, lambda acc, v: acc + v, 0)
+    assert total == 3
+    assert m.fold_over_range(12, 18, lambda acc, v: acc + v, 0) == 0
+    assert m.fold_values(lambda acc, v: acc + v, 0) == 3
