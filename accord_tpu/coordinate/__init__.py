@@ -1,0 +1,13 @@
+from accord_tpu.coordinate.errors import (
+    CoordinationFailed, Timeout, Preempted, Invalidated, Exhausted,
+)
+from accord_tpu.coordinate.tracking import (
+    RequestStatus, QuorumTracker, FastPathTracker, ReadTracker, AppliedTracker,
+)
+from accord_tpu.coordinate.transaction import CoordinateTransaction
+
+__all__ = [
+    "CoordinationFailed", "Timeout", "Preempted", "Invalidated", "Exhausted",
+    "RequestStatus", "QuorumTracker", "FastPathTracker", "ReadTracker",
+    "AppliedTracker", "CoordinateTransaction",
+]

@@ -1,0 +1,187 @@
+"""Quorum trackers: per-shard x per-epoch response accounting.
+
+Role-equivalent to the reference's coordinate/tracking package
+(AbstractTracker.java:37, QuorumTracker.java:27, FastPathTracker.java:34,
+ReadTracker.java:40, AppliedTracker.java:29). A coordination round sends one
+request per node; each response is credited to EVERY (epoch, shard) the node
+replicates, and the round completes when every shard in every spanned epoch
+reaches its criterion.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from accord_tpu.primitives.keyspace import Seekables
+from accord_tpu.primitives.timestamp import NodeId
+from accord_tpu.topology.shard import Shard
+from accord_tpu.topology.topologies import Topologies
+from accord_tpu.utils.invariants import Invariants
+
+
+class RequestStatus(enum.Enum):
+    NO_CHANGE = "no_change"
+    SUCCESS = "success"
+    FAILED = "failed"
+
+
+class _ShardState:
+    __slots__ = ("shard", "successes", "failures", "fast_votes", "fast_rejects")
+
+    def __init__(self, shard: Shard):
+        self.shard = shard
+        self.successes: Set[NodeId] = set()
+        self.failures: Set[NodeId] = set()
+        self.fast_votes: Set[NodeId] = set()
+        self.fast_rejects: Set[NodeId] = set()  # electorate members voting non-fast or failed
+
+    # -- slow/classic quorum -------------------------------------------------
+    def has_quorum(self) -> bool:
+        return len(self.successes) >= self.shard.slow_path_quorum_size
+
+    def has_failed(self) -> bool:
+        return len(self.failures) > self.shard.max_failures
+
+    # -- fast path -----------------------------------------------------------
+    def fast_achieved(self) -> bool:
+        return len(self.fast_votes) >= self.shard.fast_path_quorum_size
+
+    def fast_impossible(self) -> bool:
+        e = self.shard.fast_path_electorate
+        pending = len(e) - len(self.fast_votes) - len(self.fast_rejects & e)
+        return len(self.fast_votes) + pending < self.shard.fast_path_quorum_size
+
+    def fast_resolved(self) -> bool:
+        return self.fast_achieved() or self.fast_impossible()
+
+
+class AbstractTracker:
+    def __init__(self, topologies: Topologies, seekables: Optional[Seekables] = None):
+        self.topologies = topologies
+        self.shards: List[_ShardState] = []
+        self._by_node: Dict[NodeId, List[_ShardState]] = {}
+        for topology in topologies:
+            shards = (topology.shards_for(seekables) if seekables is not None
+                      else topology.shards)
+            for shard in shards:
+                st = _ShardState(shard)
+                self.shards.append(st)
+                for n in shard.nodes:
+                    self._by_node.setdefault(n, []).append(st)
+        Invariants.check_state(bool(self.shards), "tracker over zero shards")
+        self._decided: Optional[RequestStatus] = None
+
+    def nodes(self) -> Tuple[NodeId, ...]:
+        return tuple(sorted(self._by_node))
+
+    def _decide(self) -> RequestStatus:
+        if self._decided is not None:
+            return RequestStatus.NO_CHANGE
+        if any(s.has_failed() for s in self.shards):
+            self._decided = RequestStatus.FAILED
+            return RequestStatus.FAILED
+        if self._is_success():
+            self._decided = RequestStatus.SUCCESS
+            return RequestStatus.SUCCESS
+        return RequestStatus.NO_CHANGE
+
+    def _is_success(self) -> bool:
+        raise NotImplementedError
+
+    @property
+    def decided(self) -> Optional[RequestStatus]:
+        return self._decided
+
+    def on_failure(self, node: NodeId) -> RequestStatus:
+        for st in self._by_node.get(node, ()):
+            st.failures.add(node)
+            if node in st.shard.fast_path_electorate:
+                st.fast_rejects.add(node)
+        return self._decide()
+
+
+class QuorumTracker(AbstractTracker):
+    """Simple majority in every shard of every epoch."""
+
+    def on_success(self, node: NodeId) -> RequestStatus:
+        for st in self._by_node.get(node, ()):
+            st.successes.add(node)
+        return self._decide()
+
+    def _is_success(self) -> bool:
+        return all(s.has_quorum() for s in self.shards)
+
+
+class FastPathTracker(AbstractTracker):
+    """Tracks slow quorum and the fast-path electorate simultaneously
+    (reference: FastPathTracker.java:34): success requires a quorum everywhere
+    AND the fast path either achieved or ruled out everywhere, so the
+    coordinator never commits slow-path while fast was still possible."""
+
+    def on_success(self, node: NodeId, fast_vote: bool) -> RequestStatus:
+        for st in self._by_node.get(node, ()):
+            st.successes.add(node)
+            if node in st.shard.fast_path_electorate:
+                (st.fast_votes if fast_vote else st.fast_rejects).add(node)
+        return self._decide()
+
+    def _is_success(self) -> bool:
+        return all(s.has_quorum() and s.fast_resolved() for s in self.shards)
+
+    def has_fast_path_accepted(self) -> bool:
+        return all(s.fast_achieved() for s in self.shards)
+
+
+class ReadTracker(AbstractTracker):
+    """Data quorum: one successful read covering every shard, escalating to
+    further replicas on failure (reference: ReadTracker.java:40 trySendMore)."""
+
+    def __init__(self, topologies: Topologies, seekables: Optional[Seekables] = None):
+        super().__init__(topologies, seekables)
+        self._contacted: Set[NodeId] = set()
+        self._data: Set[int] = set()  # indexes of shards with data
+
+    def initial_contacts(self, prefer: Optional[NodeId] = None) -> Tuple[NodeId, ...]:
+        """Pick one replica per shard (deduplicated), preferring `prefer`."""
+        chosen: Set[NodeId] = set()
+        for i, st in enumerate(self.shards):
+            if any(n in chosen for n in st.shard.nodes):
+                continue
+            if prefer is not None and prefer in st.shard.nodes:
+                chosen.add(prefer)
+            else:
+                chosen.add(st.shard.nodes[0])
+        self._contacted.update(chosen)
+        return tuple(sorted(chosen))
+
+    def on_data_success(self, node: NodeId) -> RequestStatus:
+        for i, st in enumerate(self.shards):
+            if node in st.shard.nodes:
+                st.successes.add(node)
+                self._data.add(i)
+        return self._decide()
+
+    def on_read_failure(self, node: NodeId) -> Tuple[RequestStatus, Tuple[NodeId, ...]]:
+        """Returns (status, additional nodes to contact)."""
+        for st in self._by_node.get(node, ()):
+            st.failures.add(node)
+        more: Set[NodeId] = set()
+        for i, st in enumerate(self.shards):
+            if i in self._data or node not in st.shard.nodes:
+                continue
+            candidates = [n for n in st.shard.nodes if n not in self._contacted]
+            if candidates:
+                more.add(candidates[0])
+            elif all(n in self._contacted for n in st.shard.nodes) and \
+                    not any(n not in st.failures for n in st.shard.nodes if n in self._contacted):
+                self._decided = RequestStatus.FAILED
+                return RequestStatus.FAILED, ()
+        self._contacted.update(more)
+        return self._decide(), tuple(sorted(more))
+
+    def _is_success(self) -> bool:
+        return len(self._data) == len(self.shards)
+
+
+class AppliedTracker(QuorumTracker):
+    """Quorum of Apply acks per shard (durability tracking)."""

@@ -1,0 +1,271 @@
+"""CoordinateTransaction: the client-side transaction driver.
+
+Role-equivalent to the reference's CoordinateTransaction + CoordinationAdapter
+chain (coordinate/CoordinateTransaction.java:50, Propose.java:53,
+StabiliseTxn.java:35, ExecuteTxn.java:53, Persist.java:43):
+
+  PreAccept (FastPathTracker)
+    fast path:  executeAt = txnId, deps = union of fast voters' deps
+    slow path:  executeAt = max(witnessedAt), Accept round (Propose), deps
+                extended with accept-round deps
+  Stabilise+Execute: Commit(Stable) to all replicas, with the read embedded at
+    one replica per shard (commit-and-read overlap); stable quorum + data.
+  Persist: client callback fires with the Result BEFORE the Apply round --
+    Apply is off the latency path (reference: CoordinationAdapter.java:187-192).
+
+Fast path client latency = 2 message round trips; slow path = 3.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from accord_tpu.coordinate.errors import Exhausted, Preempted, Timeout
+from accord_tpu.coordinate.tracking import (
+    AppliedTracker, FastPathTracker, QuorumTracker, ReadTracker, RequestStatus,
+)
+from accord_tpu.messages import (
+    Accept, AcceptNack, AcceptOk, Apply, ApplyOk, Callback, Commit, CommitOk,
+    PreAccept, PreAcceptNack, PreAcceptOk, ReadNack, ReadOk, ReadTxnData,
+)
+from accord_tpu.primitives.deps import Deps
+from accord_tpu.primitives.routes import Route
+from accord_tpu.primitives.timestamp import Ballot, Timestamp, TxnId
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.utils.async_ import AsyncResult
+from accord_tpu.utils.invariants import Invariants
+
+
+class CoordinateTransaction:
+    def __init__(self, node, txn_id: TxnId, txn: Txn, route: Route):
+        self.node = node
+        self.txn_id = txn_id
+        self.txn = txn
+        self.route = route
+        self.result: AsyncResult = AsyncResult()
+        self.topologies = node.topology_manager.with_unsynced_epochs(
+            route, txn_id.epoch, txn_id.epoch)
+        self.execute_at: Optional[Timestamp] = None
+        self.deps: Deps = Deps.NONE
+
+    @classmethod
+    def coordinate(cls, node, txn_id: TxnId, txn: Txn, route: Route) -> AsyncResult:
+        self = cls(node, txn_id, txn, route)
+        self._start_preaccept()
+        return self.result
+
+    # -- phase 1: PreAccept --------------------------------------------------
+    def _start_preaccept(self) -> None:
+        round_ = _PreAcceptRound(self)
+        for to in round_.tracker.nodes():
+            self.node.send(to, PreAccept(self.txn_id, self.txn, self.route), round_)
+
+    def _on_preaccepted(self, round_: "_PreAcceptRound") -> None:
+        if round_.tracker.has_fast_path_accepted():
+            # (reference: CoordinateTransaction.java:73-77)
+            self.execute_at = self.txn_id.as_timestamp()
+            self.deps = Deps.merge([ok.deps for ok in round_.oks.values()
+                                    if ok.is_fast_path_vote])
+            self.node.events.on_fast_path_taken(self.txn_id)
+            self._start_execute()
+        else:
+            self.execute_at = max(ok.witnessed_at for ok in round_.oks.values())
+            self.deps = Deps.merge([ok.deps for ok in round_.oks.values()])
+            self.node.events.on_slow_path_taken(self.txn_id)
+            Invariants.check_state(
+                self.execute_at.epoch == self.txn_id.epoch or
+                self.node.topology_manager.has_epoch(self.execute_at.epoch),
+                "executeAt epoch %s unknown", self.execute_at.epoch)
+            self._start_propose()
+
+    # -- phase 2 (slow path): Accept -----------------------------------------
+    def _start_propose(self) -> None:
+        round_ = _ProposeRound(self)
+        for to in round_.tracker.nodes():
+            self.node.send(to, Accept(self.txn_id, Ballot.ZERO, self.route,
+                                      self.txn.keys, self.execute_at), round_)
+
+    def _on_accepted(self, round_: "_ProposeRound") -> None:
+        self.deps = Deps.merge([self.deps] + [ok.deps for ok in round_.oks.values()])
+        self._start_execute()
+
+    # -- phase 3: Stabilise + Execute (commit-and-read overlap) --------------
+    def _start_execute(self) -> None:
+        _ExecuteRound(self).start()
+
+    def _on_executed(self, data) -> None:
+        writes = self.txn.execute(self.txn_id, self.execute_at, data)
+        result = self.txn.result(self.txn_id, self.execute_at, data)
+        self._persist(writes, result)
+
+    # -- phase 4: Persist (off the client latency path) ----------------------
+    def _persist(self, writes, result) -> None:
+        self.result.try_set_success(result)
+        round_ = _ApplyRound(self)
+        for to in round_.tracker.nodes():
+            self.node.send(to, Apply(self.txn_id, self.route, self.txn,
+                                     self.execute_at, self.deps, writes, result),
+                           round_)
+
+    # -- shared failure handling ---------------------------------------------
+    def _fail(self, failure: BaseException) -> None:
+        if not self.result.done:
+            self.node.events.on_timeout(self.txn_id)
+            self.result.set_failure(failure)
+
+    @property
+    def done(self) -> bool:
+        return self.result.done
+
+
+class _PreAcceptRound(Callback):
+    def __init__(self, parent: CoordinateTransaction):
+        self.parent = parent
+        self.tracker = FastPathTracker(parent.topologies, parent.txn.keys)
+        self.oks: Dict[int, PreAcceptOk] = {}
+        self.nacked = False
+
+    def on_success(self, from_node, reply) -> None:
+        if self.parent.done or self.tracker.decided is not None:
+            return
+        if isinstance(reply, PreAcceptNack):
+            # a recovery coordinator holds a higher ballot
+            self.nacked = True
+            self._handle(self.tracker.on_failure(from_node))
+            return
+        self.oks[from_node] = reply
+        self._handle(self.tracker.on_success(from_node, reply.is_fast_path_vote))
+
+    def on_failure(self, from_node, failure) -> None:
+        if self.parent.done or self.tracker.decided is not None:
+            return
+        self._handle(self.tracker.on_failure(from_node))
+
+    def _handle(self, status: RequestStatus) -> None:
+        if status == RequestStatus.SUCCESS:
+            self.parent._on_preaccepted(self)
+        elif status == RequestStatus.FAILED:
+            self.parent._fail(Preempted(str(self.parent.txn_id)) if self.nacked
+                              else Timeout(f"preaccept {self.parent.txn_id}"))
+
+
+class _ProposeRound(Callback):
+    def __init__(self, parent: CoordinateTransaction):
+        self.parent = parent
+        self.tracker = QuorumTracker(parent.topologies, parent.txn.keys)
+        self.oks: Dict[int, AcceptOk] = {}
+        self.nacked = False
+
+    def on_success(self, from_node, reply) -> None:
+        if self.parent.done or self.tracker.decided is not None:
+            return
+        if isinstance(reply, AcceptNack):
+            self.nacked = True
+            self._handle(self.tracker.on_failure(from_node))
+            return
+        self.oks[from_node] = reply
+        self._handle(self.tracker.on_success(from_node))
+
+    def on_failure(self, from_node, failure) -> None:
+        if self.parent.done or self.tracker.decided is not None:
+            return
+        self._handle(self.tracker.on_failure(from_node))
+
+    def _handle(self, status: RequestStatus) -> None:
+        if status == RequestStatus.SUCCESS:
+            self.parent._on_accepted(self)
+        elif status == RequestStatus.FAILED:
+            self.parent._fail(Preempted(str(self.parent.txn_id)) if self.nacked
+                              else Timeout(f"accept {self.parent.txn_id}"))
+
+
+class _ExecuteRound(Callback):
+    """Commit(Stable) to every replica; the read rides on one replica per
+    shard (reference: ExecuteTxn.java:84-145 + Commit.stableAndRead)."""
+
+    def __init__(self, parent: CoordinateTransaction):
+        self.parent = parent
+        self.stable_tracker = QuorumTracker(parent.topologies, parent.txn.keys)
+        read = parent.txn.read
+        self.needs_read = read is not None and len(tuple(iter(read.keys()))) > 0
+        self.read_tracker = (ReadTracker(parent.topologies, read.keys())
+                             if self.needs_read else None)
+        self.data = None
+        self.data_done = not self.needs_read
+
+    def start(self) -> None:
+        p = self.parent
+        read_targets = (set(self.read_tracker.initial_contacts(prefer=p.node.id))
+                        if self.needs_read else set())
+        for to in self.stable_tracker.nodes():
+            p.node.send(to, Commit(p.txn_id, p.route, p.txn, p.execute_at,
+                                   p.deps, read=(to in read_targets)), self)
+        self._maybe_done()
+
+    def on_success(self, from_node, reply) -> None:
+        p = self.parent
+        if p.done:
+            return
+        if isinstance(reply, (CommitOk,)):
+            self._handle_stable(self.stable_tracker.on_success(from_node))
+        elif isinstance(reply, ReadOk):
+            if reply.data is not None:
+                self.data = reply.data if self.data is None else self.data.merge(reply.data)
+            self._handle_stable(self.stable_tracker.on_success(from_node))
+            if self.needs_read:
+                st = self.read_tracker.on_data_success(from_node)
+                if st == RequestStatus.SUCCESS:
+                    self.data_done = True
+            self._maybe_done()
+        elif isinstance(reply, ReadNack):
+            self._read_failure(from_node)
+
+    def on_failure(self, from_node, failure) -> None:
+        if self.parent.done:
+            return
+        self._handle_stable(self.stable_tracker.on_failure(from_node))
+        if self.needs_read:
+            self._read_failure(from_node)
+
+    def _read_failure(self, from_node) -> None:
+        if self.read_tracker.decided is not None:
+            return
+        status, more = self.read_tracker.on_read_failure(from_node)
+        if status == RequestStatus.FAILED:
+            self.parent._fail(Exhausted(f"read {self.parent.txn_id}"))
+            return
+        p = self.parent
+        for to in more:
+            p.node.send(to, ReadTxnData(p.txn_id, p.txn, p.execute_at), self)
+        if status == RequestStatus.SUCCESS:
+            self.data_done = True
+            self._maybe_done()
+
+    def _handle_stable(self, status: RequestStatus) -> None:
+        if status == RequestStatus.FAILED:
+            self.parent._fail(Timeout(f"stabilise {self.parent.txn_id}"))
+        else:
+            self._maybe_done()
+
+    def _maybe_done(self) -> None:
+        if self.parent.done:
+            return
+        if self.stable_tracker.decided == RequestStatus.SUCCESS and self.data_done:
+            self.parent._on_executed(self.data)
+
+
+class _ApplyRound(Callback):
+    """Background durability tracking; the client already has its result."""
+
+    def __init__(self, parent: CoordinateTransaction):
+        self.parent = parent
+        self.tracker = AppliedTracker(parent.topologies, parent.txn.keys)
+
+    def on_success(self, from_node, reply) -> None:
+        status = self.tracker.on_success(from_node)
+        if status == RequestStatus.SUCCESS:
+            # durability quorum reached; home-shard durability gossip lands
+            # with the recovery/durability milestone
+            pass
+
+    def on_failure(self, from_node, failure) -> None:
+        self.tracker.on_failure(from_node)

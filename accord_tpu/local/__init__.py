@@ -1,0 +1,8 @@
+from accord_tpu.local.status import Status, Durability, Phase
+from accord_tpu.local.command import Command, WaitingOn
+from accord_tpu.local.store import CommandStore
+from accord_tpu.local.stores import CommandStores
+from accord_tpu.local.node import Node
+
+__all__ = ["Status", "Durability", "Phase", "Command", "WaitingOn",
+           "CommandStore", "CommandStores", "Node"]

@@ -1,0 +1,124 @@
+"""CommandsForKey: the per-key conflict registry.
+
+Role-equivalent to the reference's local/cfk/CommandsForKey.java:171 -- for
+each key, every witnessed transaction id with a compact status summary, in
+TxnId order. This is the structure the deps-calculation hot loop scans
+(mapReduceActive, CommandsForKey.java:910): PreAccept/Accept ask "which
+witnessed txns conflict with and started before X?".
+
+The host (CPU) scan lives here; the TPU data plane (accord_tpu.ops) answers
+the same query for micro-batches of transactions with interval bitmaps and a
+boolean-matmul closure, behind the DepsResolver SPI. Keeping this registry's
+contents reproducible from Commit/Apply messages is what makes the two paths
+differentially testable.
+
+The reference additionally compresses deps implicitly (store only missing[]
+divergences) and prunes via prunedBefore; we keep explicit per-key id sets and
+will add pruning with the durability/truncation milestone.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from accord_tpu.primitives.timestamp import Timestamp, TxnId, TxnKind
+
+
+class CfkStatus(enum.IntEnum):
+    """Compact per-key status summary (reference: cfk InternalStatus)."""
+    WITNESSED = 0       # preaccepted/accepted: executeAt not final
+    COMMITTED = 1       # executeAt decided
+    APPLIED = 2         # executed + applied locally
+    INVALIDATED = 3     # never executes; excluded from deps
+
+
+class CfkInfo:
+    __slots__ = ("status", "execute_at")
+
+    def __init__(self, status: CfkStatus, execute_at: Optional[Timestamp]):
+        self.status = status
+        self.execute_at = execute_at
+
+    def __repr__(self):
+        return f"{self.status.name}@{self.execute_at!r}"
+
+
+class CommandsForKey:
+    __slots__ = ("key", "_infos", "_sorted", "max_applied_write")
+
+    def __init__(self, key):
+        self.key = key
+        self._infos: Dict[TxnId, CfkInfo] = {}
+        self._sorted: Optional[List[TxnId]] = []
+        # highest applied write executeAt for read-timestamp validation
+        self.max_applied_write: Optional[Timestamp] = None
+
+    # -- registration --------------------------------------------------------
+    def update(self, txn_id: TxnId, status: CfkStatus,
+               execute_at: Optional[Timestamp]) -> None:
+        info = self._infos.get(txn_id)
+        if info is None:
+            self._infos[txn_id] = CfkInfo(status, execute_at)
+            self._sorted = None  # re-sort lazily
+        else:
+            if status > info.status:
+                info.status = status
+            if execute_at is not None:
+                info.execute_at = execute_at
+        if status == CfkStatus.APPLIED and txn_id.is_write:
+            ea = execute_at if execute_at is not None else txn_id
+            if self.max_applied_write is None or ea > self.max_applied_write:
+                self.max_applied_write = ea
+
+    def remove(self, txn_id: TxnId) -> None:
+        if txn_id in self._infos:
+            del self._infos[txn_id]
+            self._sorted = None
+
+    # -- queries -------------------------------------------------------------
+    def _ids(self) -> List[TxnId]:
+        if self._sorted is None:
+            self._sorted = sorted(self._infos)
+        return self._sorted
+
+    def get(self, txn_id: TxnId) -> Optional[CfkInfo]:
+        return self._infos.get(txn_id)
+
+    def is_empty(self) -> bool:
+        return not self._infos
+
+    def __len__(self) -> int:
+        return len(self._infos)
+
+    def conflicts_before(self, subject: TxnId, before: Timestamp) -> Iterator[TxnId]:
+        """All witnessed txn ids t != subject with t < before that `subject`'s
+        kind witnesses and that may still execute (not invalidated). This is
+        the deps-calculation scan (reference mapReduceActive semantics:
+        STARTED_BEFORE(before) + kind filter)."""
+        kind = subject.kind
+        for t in self._ids():
+            if not t < before:
+                break
+            if t == subject:
+                continue
+            info = self._infos[t]
+            if info.status == CfkStatus.INVALIDATED:
+                continue
+            if kind.witnesses(t.kind):
+                yield t
+
+    def max_conflict(self, subject_kind: TxnKind) -> Optional[Timestamp]:
+        """Max (txn_id, execute_at) among witnessed conflicting txns."""
+        out: Optional[Timestamp] = None
+        for t, info in self._infos.items():
+            if info.status == CfkStatus.INVALIDATED:
+                continue
+            if not subject_kind.witnesses(t.kind) and not t.kind.witnesses(subject_kind):
+                continue
+            c = info.execute_at if info.execute_at is not None and info.execute_at > t else t
+            if out is None or c > out:
+                out = c
+        return out
+
+    def __repr__(self):
+        return f"CFK({self.key}: {len(self._infos)} txns)"

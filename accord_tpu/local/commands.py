@@ -1,0 +1,331 @@
+"""Command state transitions.
+
+Role-equivalent to the reference's Commands static functions
+(local/Commands.java:90): preaccept (:113), accept (:202), commit (:289),
+apply (:462), commitInvalidate (:434), and the execution scheduling walk
+(maybeExecute / updateDependencyAndMaybeExecute :777 / NotifyWaitingOn :960).
+Every mutation of a Command flows through here so listener notification,
+conflict-registry registration and progress-log callbacks stay consistent.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Optional, Set
+
+from accord_tpu.local.cfk import CfkStatus
+from accord_tpu.local.command import Command, WaitingOn
+from accord_tpu.local.status import Durability, Status
+from accord_tpu.local.store import CommandStore
+from accord_tpu.primitives.deps import Deps
+from accord_tpu.primitives.keyspace import Keys, Ranges
+from accord_tpu.primitives.routes import Route
+from accord_tpu.primitives.timestamp import Ballot, Timestamp, TxnId
+from accord_tpu.primitives.txn import PartialTxn
+from accord_tpu.primitives.writes import Writes
+from accord_tpu.utils.invariants import Invariants
+
+
+class AcceptOutcome(enum.Enum):
+    SUCCESS = "success"
+    REDUNDANT = "redundant"
+    REJECTED_BALLOT = "rejected_ballot"
+    TRUNCATED = "truncated"
+
+
+# ---------------------------------------------------------------------------
+# PreAccept
+# ---------------------------------------------------------------------------
+
+def preaccept(store: CommandStore, txn_id: TxnId, txn: PartialTxn, route: Route,
+              ballot: Ballot = Ballot.ZERO) -> AcceptOutcome:
+    """Witness the txn: record definition, pick the witnessed timestamp
+    (stored provisionally in execute_at), register the conflict.
+    (reference: Commands.preacceptOrRecover, local/Commands.java:125)"""
+    cmd = store.command(txn_id)
+    if cmd.status.is_terminal:
+        return AcceptOutcome.REJECTED_BALLOT if cmd.is_(Status.INVALIDATED) \
+            else AcceptOutcome.TRUNCATED
+    if cmd.promised > ballot:
+        return AcceptOutcome.REJECTED_BALLOT
+    if cmd.known_definition:
+        # duplicate delivery or competing recovery; just raise the promise
+        cmd.promised = max(cmd.promised, ballot)
+        return AcceptOutcome.REDUNDANT if ballot == Ballot.ZERO else AcceptOutcome.SUCCESS
+
+    cmd.txn = txn if cmd.txn is None else cmd.txn
+    cmd.route = route if cmd.route is None else cmd.route
+    cmd.promised = max(cmd.promised, ballot)
+
+    if cmd.execute_at is None:
+        # recovery (non-zero ballot) must not take new fast-path decisions
+        witnessed = store.preaccept_timestamp(txn_id, store.owned(txn.keys),
+                                              permit_fast_path=(ballot == Ballot.ZERO))
+        cmd.execute_at = witnessed
+        cmd.status = Status.PRE_ACCEPTED
+        store.register(txn_id, txn.keys, CfkStatus.WITNESSED, witnessed)
+        store.progress_log.preaccepted(cmd, _is_home(store, cmd))
+    else:
+        cmd.status = max(cmd.status, Status.PRE_ACCEPTED)
+
+    notify_listeners(store, cmd)
+    return AcceptOutcome.SUCCESS
+
+
+# ---------------------------------------------------------------------------
+# Accept (slow-path executeAt proposal)
+# ---------------------------------------------------------------------------
+
+def accept(store: CommandStore, txn_id: TxnId, ballot: Ballot, route: Route,
+           keys, execute_at: Timestamp) -> AcceptOutcome:
+    """(reference: Commands.accept, local/Commands.java:202)"""
+    cmd = store.command(txn_id)
+    if cmd.status.is_terminal:
+        return AcceptOutcome.REJECTED_BALLOT if cmd.is_(Status.INVALIDATED) \
+            else AcceptOutcome.TRUNCATED
+    if cmd.promised > ballot:
+        return AcceptOutcome.REDUNDANT if cmd.has_been(Status.COMMITTED) \
+            else AcceptOutcome.REJECTED_BALLOT
+    if cmd.has_been(Status.COMMITTED):
+        return AcceptOutcome.REDUNDANT
+
+    cmd.route = route if cmd.route is None else cmd.route
+    cmd.execute_at = execute_at
+    cmd.promised = ballot
+    cmd.accepted_ballot = ballot
+    cmd.status = Status.ACCEPTED
+    store.register(txn_id, keys, CfkStatus.WITNESSED, execute_at)
+    store.progress_log.accepted(cmd, _is_home(store, cmd))
+    notify_listeners(store, cmd)
+    return AcceptOutcome.SUCCESS
+
+
+def accept_invalidate(store: CommandStore, txn_id: TxnId, ballot: Ballot) -> AcceptOutcome:
+    """Ballot-accept a proposal to invalidate (reference: Commands.acceptInvalidate)."""
+    cmd = store.command(txn_id)
+    if cmd.status.is_terminal:
+        return AcceptOutcome.REDUNDANT
+    if cmd.promised > ballot:
+        return AcceptOutcome.REJECTED_BALLOT
+    if cmd.has_been(Status.COMMITTED):
+        return AcceptOutcome.REDUNDANT
+    cmd.promised = ballot
+    cmd.accepted_ballot = ballot
+    cmd.status = max(cmd.status, Status.ACCEPTED_INVALIDATE)
+    notify_listeners(store, cmd)
+    return AcceptOutcome.SUCCESS
+
+
+# ---------------------------------------------------------------------------
+# Commit
+# ---------------------------------------------------------------------------
+
+class CommitOutcome(enum.Enum):
+    SUCCESS = "success"
+    REDUNDANT = "redundant"
+    INSUFFICIENT = "insufficient"
+
+
+def commit(store: CommandStore, txn_id: TxnId, route: Route, txn: Optional[PartialTxn],
+           execute_at: Timestamp, deps: Deps) -> CommitOutcome:
+    """Commit(Stable): executeAt + deps are final; build the local wait graph
+    and schedule execution (reference: Commands.commit, local/Commands.java:289)."""
+    cmd = store.command(txn_id)
+    if cmd.has_been(Status.STABLE):
+        if not cmd.status.is_terminal and cmd.execute_at != execute_at:
+            store.node.agent.on_inconsistent_timestamp(cmd, cmd.execute_at, execute_at)
+        return CommitOutcome.REDUNDANT
+    if cmd.txn is None and txn is None:
+        return CommitOutcome.INSUFFICIENT
+    if txn is not None:
+        cmd.txn = txn if cmd.txn is None else cmd.txn.union(txn)
+    cmd.route = route if cmd.route is None else cmd.route
+    cmd.execute_at = execute_at
+    cmd.deps = deps
+    cmd.status = Status.STABLE
+    store.register(txn_id, cmd.txn.keys, CfkStatus.COMMITTED,
+                   max(execute_at, txn_id.as_timestamp()), execute_at)
+    _init_waiting_on(store, cmd)
+    store.progress_log.stable(cmd, _is_home(store, cmd))
+    store.node.events.on_stable(cmd)
+    notify_listeners(store, cmd)
+    maybe_execute(store, cmd)
+    return CommitOutcome.SUCCESS
+
+
+def precommit(store: CommandStore, txn_id: TxnId, execute_at: Timestamp) -> None:
+    """executeAt learned (e.g. via recovery/propagate) without deps
+    (reference: Commands.precommit, local/Commands.java:353)."""
+    cmd = store.command(txn_id)
+    if cmd.has_been(Status.PRE_COMMITTED) or cmd.status.is_terminal:
+        return
+    cmd.execute_at = execute_at
+    cmd.status = Status.PRE_COMMITTED
+    if cmd.txn is not None:
+        store.register(txn_id, cmd.txn.keys, CfkStatus.COMMITTED,
+                       max(execute_at, txn_id.as_timestamp()), execute_at)
+    notify_listeners(store, cmd)
+
+
+def commit_invalidate(store: CommandStore, txn_id: TxnId) -> None:
+    """(reference: Commands.commitInvalidate, local/Commands.java:434)"""
+    cmd = store.command(txn_id)
+    if cmd.has_been(Status.STABLE) and not cmd.is_(Status.INVALIDATED):
+        Invariants.check_state(False, "invalidating a stable command %s", cmd)
+    if cmd.status.is_terminal:
+        return
+    cmd.status = Status.INVALIDATED
+    if cmd.txn is not None:
+        store.register(txn_id, cmd.txn.keys, CfkStatus.INVALIDATED, txn_id.as_timestamp())
+    store.node.events.on_invalidated(txn_id)
+    store.progress_log.clear(txn_id)
+    notify_listeners(store, cmd)
+
+
+# ---------------------------------------------------------------------------
+# Apply / execution
+# ---------------------------------------------------------------------------
+
+def apply(store: CommandStore, txn_id: TxnId, route: Route, txn: Optional[PartialTxn],
+          execute_at: Timestamp, deps: Deps, writes: Optional[Writes], result) -> CommitOutcome:
+    """Persist the outcome; execute (write to the data store) once local deps
+    have applied (reference: Commands.apply, local/Commands.java:462)."""
+    cmd = store.command(txn_id)
+    if cmd.has_been(Status.PRE_APPLIED):
+        if not cmd.status.is_terminal and cmd.execute_at != execute_at:
+            store.node.agent.on_inconsistent_timestamp(cmd, cmd.execute_at, execute_at)
+        return CommitOutcome.REDUNDANT
+    if cmd.txn is None and txn is None:
+        return CommitOutcome.INSUFFICIENT
+    if txn is not None:
+        cmd.txn = txn if cmd.txn is None else cmd.txn.union(txn)
+    cmd.route = route if cmd.route is None else cmd.route
+    was_stable = cmd.has_been(Status.STABLE)
+    cmd.execute_at = execute_at
+    if cmd.deps is None:
+        cmd.deps = deps
+    cmd.writes = writes
+    cmd.result = result
+    cmd.status = Status.PRE_APPLIED
+    store.register(txn_id, cmd.txn.keys, CfkStatus.COMMITTED,
+                   max(execute_at, txn_id.as_timestamp()), execute_at)
+    if not was_stable:
+        _init_waiting_on(store, cmd)
+    store.progress_log.executed(cmd, _is_home(store, cmd))
+    notify_listeners(store, cmd)
+    maybe_execute(store, cmd)
+    return CommitOutcome.SUCCESS
+
+
+def _init_waiting_on(store: CommandStore, cmd: Command) -> None:
+    """Build WaitingOn from deps: every dep on a key/range this store owns
+    gates us until it is committed; committed deps executing before us gate us
+    until applied (reference: Command.WaitingOn.Update + Commands.maybeExecute)."""
+    wo = WaitingOn()
+    cmd.waiting_on = wo
+    deps = cmd.deps.slice(store.ranges) if cmd.deps is not None else None
+    if deps is None or deps.is_empty():
+        return
+    for dep_id in deps.all_txn_ids():
+        if dep_id == cmd.txn_id:
+            continue
+        dep = store.command(dep_id)
+        if dep.is_(Status.INVALIDATED):
+            continue
+        if dep.known_execute_at:
+            if dep.execute_at > cmd.execute_at or dep.has_been(Status.APPLIED):
+                continue
+            wo.apply.add(dep_id)
+            dep.add_waiter(cmd.txn_id)
+        else:
+            wo.commit.add(dep_id)
+            dep.add_waiter(cmd.txn_id)
+
+
+def maybe_execute(store: CommandStore, cmd: Command) -> None:
+    """(reference: Commands.maybeExecute, local/Commands.java:713)"""
+    if cmd.status not in (Status.STABLE, Status.PRE_APPLIED):
+        return
+    if cmd.waiting_on is not None and not cmd.waiting_on.is_done():
+        _report_waiting(store, cmd)
+        return
+    if cmd.status == Status.STABLE:
+        cmd.status = Status.READY_TO_EXECUTE
+        store.progress_log.readyToExecute(cmd)
+        notify_listeners(store, cmd)
+    else:  # PRE_APPLIED -> perform the writes
+        _do_apply(store, cmd)
+
+
+def _do_apply(store: CommandStore, cmd: Command) -> None:
+    if cmd.writes is not None:
+        cmd.writes.apply_to(store, store.ranges)
+    cmd.status = Status.APPLIED
+    store.register(cmd.txn_id, cmd.txn.keys, CfkStatus.APPLIED,
+                   max(cmd.execute_at, cmd.txn_id.as_timestamp()), cmd.execute_at)
+    store.node.events.on_applied(cmd, 0.0)
+    store.progress_log.clear(cmd.txn_id)
+    notify_listeners(store, cmd)
+
+
+def _report_waiting(store: CommandStore, cmd: Command) -> None:
+    wo = cmd.waiting_on
+    if wo.commit:
+        blocked = min(wo.commit)
+        store.progress_log.waiting(blocked, Status.COMMITTED, None)
+    elif wo.apply:
+        store.progress_log.waiting(min(wo.apply), Status.APPLIED, None)
+
+
+# ---------------------------------------------------------------------------
+# Listener notification (the dependency-graph walk)
+# ---------------------------------------------------------------------------
+
+def notify_listeners(store: CommandStore, cmd: Command) -> None:
+    """Tell every dependent command and transient listener that `cmd` changed
+    (reference: AbstractSafeCommandStore.notifyListeners +
+    Commands.NotifyWaitingOn)."""
+    for waiter_id in list(cmd.waiters):
+        waiter = store.command_if_present(waiter_id)
+        if waiter is None:
+            cmd.remove_waiter(waiter_id)
+            continue
+        _update_dependency(store, waiter, cmd)
+    for listener in list(cmd.transient_listeners):
+        listener.on_change(store, cmd)
+
+
+def _update_dependency(store: CommandStore, waiter: Command, dep: Command) -> None:
+    """(reference: Commands.updateDependencyAndMaybeExecute, local/Commands.java:777)"""
+    wo = waiter.waiting_on
+    if wo is None:
+        dep.remove_waiter(waiter.txn_id)
+        return
+    d = dep.txn_id
+    changed = False
+    if dep.is_(Status.INVALIDATED) or dep.is_(Status.TRUNCATED):
+        wo.commit.discard(d)
+        wo.apply.discard(d)
+        dep.remove_waiter(waiter.txn_id)
+        changed = True
+    elif d in wo.commit and dep.known_execute_at:
+        wo.commit.discard(d)
+        if dep.execute_at > waiter.execute_at or dep.has_been(Status.APPLIED):
+            dep.remove_waiter(waiter.txn_id)
+        else:
+            wo.apply.add(d)
+        changed = True
+    elif d in wo.apply and dep.has_been(Status.APPLIED):
+        wo.apply.discard(d)
+        dep.remove_waiter(waiter.txn_id)
+        changed = True
+    if changed and wo.is_done():
+        maybe_execute(store, waiter)
+
+
+def set_durability(store: CommandStore, txn_id: TxnId, durability: Durability) -> None:
+    cmd = store.command(txn_id)
+    cmd.durability = cmd.durability.merge(durability)
+
+
+def _is_home(store: CommandStore, cmd: Command) -> bool:
+    return cmd.route is not None and store.ranges.contains_key(cmd.route.home_key)

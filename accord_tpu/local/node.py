@@ -1,0 +1,160 @@
+"""Node: the composition root and facade.
+
+Role-equivalent to the reference's Node (local/Node.java:100): owns the
+MessageSink, ConfigurationService, TopologyManager, CommandStores, Agent,
+Scheduler and the hybrid logical clock; entry points coordinate()/receive().
+Everything is constructor-injected (the reference's config philosophy,
+SURVEY.md section 5).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Dict, Optional, Tuple
+
+from accord_tpu.api import Agent, ConfigurationService, EventsListener, MessageSink, Scheduler
+from accord_tpu.local.stores import CommandStores
+from accord_tpu.primitives.keyspace import Keys, Ranges, Seekables
+from accord_tpu.primitives.routes import Route
+from accord_tpu.primitives.timestamp import Domain, NodeId, Timestamp, TxnId, TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.topology.manager import TopologyManager
+from accord_tpu.topology.topologies import Topologies
+from accord_tpu.utils.async_ import AsyncResult
+from accord_tpu.utils.invariants import Invariants
+from accord_tpu.utils.rng import RandomSource
+
+
+class TimeService:
+    """Clock SPI (reference: local/NodeTimeService.java). now_micros must be
+    monotone non-decreasing per node; the simulator supplies logical time."""
+
+    def now_micros(self) -> int:
+        raise NotImplementedError
+
+
+class Node:
+    def __init__(self, node_id: NodeId, *, message_sink: MessageSink,
+                 config_service: ConfigurationService, scheduler: Scheduler,
+                 agent: Agent, rng: RandomSource, time_service: TimeService,
+                 data_store, num_stores: int = 1,
+                 progress_log_factory: Optional[Callable] = None,
+                 deps_resolver=None, events: Optional[EventsListener] = None):
+        self.id = node_id
+        self.message_sink = message_sink
+        self.config_service = config_service
+        self.scheduler = scheduler
+        self.agent = agent
+        self.rng = rng
+        self.time_service = time_service
+        self.data_store = data_store
+        self.events = events or EventsListener()
+        self.topology_manager = TopologyManager(node_id)
+        self._num_stores = num_stores
+        self._progress_log_factory = progress_log_factory
+        self._deps_resolver = deps_resolver
+        self.command_stores: Optional[CommandStores] = None
+        # HLC state (reference: Node.uniqueNow CAS loop, local/Node.java:348)
+        self._last_hlc = 0
+        # coordinator-side reply demux
+        self._next_message_id = itertools.count(1)
+        self._callbacks: Dict[int, Tuple[object, object]] = {}  # msg_id -> (callback, timeout_handle)
+        self._store_factory = None
+
+        topology = config_service.current_topology()
+        if topology is not None:
+            self.on_topology_update(topology)
+
+    # -- topology ------------------------------------------------------------
+    def on_topology_update(self, topology) -> None:
+        self.topology_manager.on_topology_update(topology)
+        owned = topology.ranges_for_node(self.id)
+        if self.command_stores is None:
+            kwargs = {}
+            if self._store_factory is not None:
+                kwargs["store_factory"] = self._store_factory
+            self.command_stores = CommandStores(
+                self, self._num_stores, owned,
+                progress_log_factory=self._progress_log_factory,
+                deps_resolver=self._deps_resolver, **kwargs)
+        # range movement handled by the topology-change milestone
+
+    @property
+    def epoch(self) -> int:
+        return self.topology_manager.epoch
+
+    def topology(self) -> TopologyManager:
+        return self.topology_manager
+
+    # -- time / id generation ------------------------------------------------
+    def unique_now(self, at_least: Optional[Timestamp] = None) -> Timestamp:
+        hlc = max(self.time_service.now_micros(), self._last_hlc + 1)
+        epoch = self.epoch
+        if at_least is not None:
+            if at_least.hlc >= hlc:
+                hlc = at_least.hlc + 1
+            epoch = max(epoch, at_least.epoch)
+        self._last_hlc = hlc
+        return Timestamp(epoch, hlc, 0, self.id)
+
+    def next_txn_id(self, kind: TxnKind, domain: Domain) -> TxnId:
+        now = self.unique_now()
+        return TxnId.create(now.epoch, now.hlc, self.id, kind, domain)
+
+    def now_millis(self) -> float:
+        return self.time_service.now_micros() / 1000.0
+
+    # -- client entry points -------------------------------------------------
+    def coordinate(self, txn: Txn, txn_id: Optional[TxnId] = None) -> AsyncResult:
+        """Coordinate a transaction; completes with its Result.
+        (reference: Node.coordinate, local/Node.java:586)"""
+        from accord_tpu.coordinate.transaction import CoordinateTransaction
+        if txn_id is None:
+            txn_id = self.next_txn_id(txn.kind, txn.domain)
+        route = self.compute_route(txn)
+        return CoordinateTransaction.coordinate(self, txn_id, txn, route)
+
+    def compute_route(self, txn: Txn) -> Route:
+        home_key = _pick_home_key(txn.keys)
+        return txn.to_route(home_key)
+
+    # -- messaging -----------------------------------------------------------
+    def send(self, to: NodeId, request, callback=None) -> None:
+        """(reference: Node.send helpers local/Node.java:437-540)"""
+        if callback is None:
+            self.message_sink.send(to, request)
+        else:
+            self.message_sink.send_with_callback(to, request, callback)
+
+    def send_to_many(self, nodes, request_factory: Callable[[NodeId], object], callback) -> None:
+        for to in nodes:
+            self.send(to, request_factory(to), callback)
+
+    def reply(self, to: NodeId, reply_context, reply) -> None:
+        self.message_sink.reply(to, reply_context, reply)
+
+    def receive(self, request, from_node: NodeId, reply_context) -> None:
+        """Ingress for protocol requests (reference: Node.receive,
+        local/Node.java:718): defers until the request's epoch is known."""
+        wait_for = getattr(request, "wait_for_epoch", 0)
+        if wait_for > self.epoch:
+            self.config_service.fetch_topology_for_epoch(wait_for)
+            self.topology_manager.await_epoch(wait_for).on_success(
+                lambda _: self.receive(request, from_node, reply_context))
+            return
+        self.scheduler.now(lambda: self._process(request, from_node, reply_context))
+
+    def _process(self, request, from_node: NodeId, reply_context) -> None:
+        try:
+            request.process(self, from_node, reply_context)
+        except BaseException as e:  # noqa: BLE001 -- agent decides
+            self.agent.on_uncaught_exception(e)
+
+
+def _pick_home_key(seekables: Seekables):
+    """Deterministic home-key selection: the first participant (the reference
+    picks trySelectHomeKey from the route; any deterministic choice works)."""
+    if isinstance(seekables, Keys):
+        Invariants.check_argument(len(seekables) > 0, "txn with no keys")
+        return seekables[0]
+    Invariants.check_argument(len(seekables) > 0, "txn with no ranges")
+    return seekables[0].start

@@ -1,0 +1,191 @@
+"""CommandStore: one single-threaded shard engine within a node.
+
+Role-equivalent to the reference's CommandStore/SafeCommandStore
+(local/CommandStore.java:82, SafeCommandStore.java:58) and the in-memory
+reference implementation (impl/InMemoryCommandStore.java:92). Owns a slice of
+the node's ranges and every per-txn Command plus per-key conflict registry for
+that slice. All access is funneled through execute()/submit() so the
+simulator can inject asynchronous load delays exactly like the reference's
+DelayedCommandStores.
+
+The deps-calculation entry points (preaccept_timestamp, calculate_deps) are
+THE hot path (reference: PreAccept.calculatePartialDeps,
+messages/PreAccept.java:245); they delegate to a pluggable DepsResolver so the
+TPU batched implementation (accord_tpu.ops) can replace the host scan.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, TYPE_CHECKING
+
+from accord_tpu.local.cfk import CfkStatus, CommandsForKey
+from accord_tpu.local.command import Command
+from accord_tpu.local.status import Status
+from accord_tpu.primitives.deps import Deps, KeyDepsBuilder, RangeDepsBuilder
+from accord_tpu.primitives.keyspace import Key, Keys, Range, Ranges, Seekables
+from accord_tpu.primitives.timestamp import Timestamp, TxnId, TxnKind
+from accord_tpu.utils.async_ import AsyncResult, success
+from accord_tpu.utils.invariants import Invariants
+from accord_tpu.utils.range_map import ReducingRangeMap
+
+if TYPE_CHECKING:
+    from accord_tpu.local.node import Node
+
+
+class CommandStore:
+    def __init__(self, store_id: int, node: "Node", ranges: Ranges,
+                 progress_log_factory: Optional[Callable] = None,
+                 deps_resolver=None):
+        self.store_id = store_id
+        self.node = node
+        self.ranges = ranges  # owned ranges (static until topology-change milestone)
+        self.commands: Dict[TxnId, Command] = {}
+        self.cfks: Dict[Key, CommandsForKey] = {}
+        self.range_txns: Dict[TxnId, Ranges] = {}  # witnessed range-domain txns
+        self.max_conflicts: ReducingRangeMap = ReducingRangeMap.EMPTY
+        self.progress_log = (progress_log_factory(self) if progress_log_factory
+                             else _NoopProgressLog())
+        self.deps_resolver = deps_resolver  # None -> host scan below
+
+    # -- execution context ---------------------------------------------------
+    def execute(self, fn: Callable[["CommandStore"], None]) -> AsyncResult:
+        """Run an operation against this store. Synchronous by default; the
+        simulator overrides submit scheduling to add async load delays."""
+        fn(self)
+        return success(None)
+
+    def submit(self, fn: Callable[["CommandStore"], object]) -> AsyncResult:
+        return success(fn(self))
+
+    # -- command access ------------------------------------------------------
+    def command(self, txn_id: TxnId) -> Command:
+        cmd = self.commands.get(txn_id)
+        if cmd is None:
+            cmd = Command(txn_id)
+            self.commands[txn_id] = cmd
+        return cmd
+
+    def command_if_present(self, txn_id: TxnId) -> Optional[Command]:
+        return self.commands.get(txn_id)
+
+    def cfk(self, key: Key) -> CommandsForKey:
+        c = self.cfks.get(key)
+        if c is None:
+            c = CommandsForKey(key)
+            self.cfks[key] = c
+        return c
+
+    # -- ownership -----------------------------------------------------------
+    def owns(self, seekables: Seekables) -> bool:
+        return seekables.intersects(self.ranges)
+
+    def owned(self, seekables: Seekables) -> Seekables:
+        return seekables.slice(self.ranges)
+
+    def owned_keys(self, seekables: Seekables) -> Keys:
+        Invariants.check_argument(isinstance(seekables, Keys))
+        return seekables.slice(self.ranges)
+
+    # -- the deps/timestamp hot path ----------------------------------------
+    def max_conflict_ts(self, seekables: Seekables) -> Optional[Timestamp]:
+        """Max witnessed conflict timestamp over the given keys/ranges
+        (reference: MaxConflicts, local/MaxConflicts.java)."""
+        out: Optional[Timestamp] = None
+        if isinstance(seekables, Keys):
+            for k in seekables:
+                v = self.max_conflicts.get(k)
+                out = Timestamp.merge_max(out, v)
+        else:
+            for r in seekables:
+                out = self.max_conflicts.fold_over_range(
+                    r.start, r.end, Timestamp.merge_max, out)
+        return out
+
+    def update_max_conflicts(self, seekables: Seekables, ts: Timestamp) -> None:
+        if isinstance(seekables, Keys):
+            for k in seekables:
+                self.max_conflicts = self.max_conflicts.with_range(
+                    k, _key_successor(k), ts, Timestamp.merge_max)
+        else:
+            for r in seekables:
+                self.max_conflicts = self.max_conflicts.with_range(
+                    r.start, r.end, ts, Timestamp.merge_max)
+
+    def preaccept_timestamp(self, txn_id: TxnId, seekables: Seekables,
+                            permit_fast_path: bool) -> Timestamp:
+        """Propose the witnessed timestamp for a PreAccept (reference:
+        CommandStore.preaccept, local/CommandStore.java:322): txnId itself iff
+        the fast path is still possible, else a fresh unique timestamp above
+        every witnessed conflict."""
+        min_non_conflicting = self.max_conflict_ts(seekables)
+        if (permit_fast_path
+                and (min_non_conflicting is None or txn_id >= min_non_conflicting)
+                and txn_id.epoch >= self.node.epoch):
+            return txn_id
+        return self.node.unique_now(min_non_conflicting or txn_id)
+
+    def calculate_deps(self, txn_id: TxnId, seekables: Seekables,
+                       before: Timestamp) -> Deps:
+        """All witnessed conflicting txns that started before `before`
+        (reference: PreAccept.calculatePartialDeps, messages/PreAccept.java:245).
+        Delegates to the DepsResolver SPI when installed (TPU path)."""
+        if self.deps_resolver is not None:
+            return self.deps_resolver.resolve_one(self, txn_id, seekables, before)
+        return self.host_calculate_deps(txn_id, seekables, before)
+
+    def host_calculate_deps(self, txn_id: TxnId, seekables: Seekables,
+                            before: Timestamp) -> Deps:
+        kb = KeyDepsBuilder()
+        rb = RangeDepsBuilder()
+        kind = txn_id.kind
+        if isinstance(seekables, Keys):
+            for k in self.owned_keys(seekables):
+                c = self.cfks.get(k)
+                if c is not None:
+                    for dep in c.conflicts_before(txn_id, before):
+                        kb.add(k, dep)
+                # range txns intersecting this key also conflict
+                for rid, rranges in self.range_txns.items():
+                    if rid != txn_id and rid < before and kind.witnesses(rid.kind) \
+                            and rranges.contains_key(k):
+                        kb.add(k, rid)
+        else:
+            owned = seekables.slice(self.ranges)
+            # key txns within the ranges
+            for k, c in self.cfks.items():
+                if owned.contains_key(k):
+                    for dep in c.conflicts_before(txn_id, before):
+                        rb.add(Range.point(k), dep)
+            # other range txns
+            for rid, rranges in self.range_txns.items():
+                if rid != txn_id and rid < before and kind.witnesses(rid.kind):
+                    inter = rranges.intersection(owned)
+                    for r in inter:
+                        rb.add(r, rid)
+        return Deps(kb.build(), rb.build())
+
+    # -- registration (feeds the conflict registry) -------------------------
+    def register(self, txn_id: TxnId, seekables: Seekables, status: CfkStatus,
+                 witnessed_at: Timestamp,
+                 execute_at: Optional[Timestamp] = None) -> None:
+        owned = self.owned(seekables)
+        if isinstance(owned, Keys):
+            for k in owned:
+                self.cfk(k).update(txn_id, status, execute_at)
+        else:
+            if status == CfkStatus.INVALIDATED:
+                self.range_txns.pop(txn_id, None)
+            else:
+                prev = self.range_txns.get(txn_id)
+                self.range_txns[txn_id] = prev.union(owned) if prev else owned
+        self.update_max_conflicts(owned, witnessed_at)
+
+
+class _NoopProgressLog:
+    def __getattr__(self, name):
+        return lambda *a, **k: None
+
+
+def _key_successor(k):
+    """End bound of a single-key interval in the max-conflicts map."""
+    from accord_tpu.primitives.keyspace import _Successor
+    return _Successor(k)

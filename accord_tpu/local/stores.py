@@ -1,0 +1,106 @@
+"""CommandStores: the intra-node sharding layer.
+
+Role-equivalent to the reference's CommandStores (local/CommandStores.java:79):
+splits the node's owned ranges over N single-threaded CommandStores via a
+pluggable splitter (reference: ShardDistributor.EvenSplit) and fans requests
+out with map-reduce over the intersecting stores. This is the reference's
+intra-node parallelism dimension (SURVEY.md 2.10); in the TPU build it is also
+the unit of micro-batching: each store's deps scans batch onto the device
+independently.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, TYPE_CHECKING
+
+from accord_tpu.local.store import CommandStore
+from accord_tpu.primitives.keyspace import Range, Ranges, Seekables
+from accord_tpu.utils.async_ import AsyncResult, all_of
+from accord_tpu.utils.invariants import Invariants
+
+if TYPE_CHECKING:
+    from accord_tpu.local.node import Node
+
+
+def even_int_splitter(rng: Range, parts: int) -> List[Range]:
+    """Default splitter for integer-like key domains (reference:
+    ShardDistributor.EvenSplit with integer Splitter)."""
+    lo, hi = rng.start, rng.end
+    try:
+        width = (hi - lo) // parts
+    except TypeError:  # non-arithmetic bounds: no split
+        return [rng]
+    if width <= 0:
+        return [rng]
+    bounds = [lo + i * width for i in range(parts)] + [hi]
+    return [Range(bounds[i], bounds[i + 1]) for i in range(parts) if bounds[i] < bounds[i + 1]]
+
+
+class CommandStores:
+    def __init__(self, node: "Node", num_stores: int, owned: Ranges,
+                 splitter: Callable[[Range, int], List[Range]] = even_int_splitter,
+                 progress_log_factory=None, deps_resolver=None,
+                 store_factory: Callable[..., CommandStore] = CommandStore):
+        self.node = node
+        self.splitter = splitter
+        per_store: List[List[Range]] = [[] for _ in range(num_stores)]
+        for rng in owned:
+            pieces = splitter(rng, num_stores)
+            if len(pieces) < num_stores:
+                # unsplittable: give whole pieces to store 0..
+                for i, p in enumerate(pieces):
+                    per_store[i % num_stores].append(p)
+            else:
+                for i, p in enumerate(pieces):
+                    per_store[i].append(p)
+        self.stores: List[CommandStore] = [
+            store_factory(i, node, Ranges(rs), progress_log_factory, deps_resolver)
+            for i, rs in enumerate(per_store)
+        ]
+
+    # -- selection -----------------------------------------------------------
+    def intersecting(self, seekables: Seekables) -> List[CommandStore]:
+        return [s for s in self.stores if not s.ranges.is_empty() and s.owns(seekables)]
+
+    def unsafe_for_key(self, key) -> Optional[CommandStore]:
+        for s in self.stores:
+            if s.ranges.contains_key(key):
+                return s
+        return None
+
+    def all(self) -> Sequence[CommandStore]:
+        return self.stores
+
+    def owned_ranges(self) -> Ranges:
+        out = Ranges.EMPTY
+        for s in self.stores:
+            out = out.union(s.ranges)
+        return out
+
+    # -- fan-out -------------------------------------------------------------
+    def map_reduce(self, seekables: Seekables,
+                   map_fn: Callable[[CommandStore], object],
+                   reduce_fn: Callable[[object, object], object]) -> AsyncResult:
+        """Run map_fn on every store intersecting seekables (each on its own
+        execution context), reduce the results (reference:
+        CommandStores.mapReduceConsume, local/CommandStores.java:626)."""
+        targets = self.intersecting(seekables)
+        Invariants.check_state(bool(targets),
+                               "no store intersects %s (owned=%s)", seekables,
+                               self.owned_ranges())
+        chains = [s.submit(map_fn) for s in targets]
+        return all_of(chains).map(lambda vs: _reduce_non_null(vs, reduce_fn))
+
+    def for_each(self, seekables: Seekables,
+                 fn: Callable[[CommandStore], None]) -> AsyncResult:
+        targets = self.intersecting(seekables)
+        chains = [s.execute(fn) for s in targets]
+        return all_of(chains).map(lambda _: None)
+
+
+def _reduce_non_null(values: list, reduce_fn):
+    acc = None
+    for v in values:
+        if v is None:
+            continue
+        acc = v if acc is None else reduce_fn(acc, v)
+    return acc

@@ -1,0 +1,14 @@
+from accord_tpu.messages.base import Request, Reply, Callback, SimpleReply
+from accord_tpu.messages.preaccept import PreAccept, PreAcceptOk, PreAcceptNack
+from accord_tpu.messages.accept import Accept, AcceptOk, AcceptNack
+from accord_tpu.messages.commit import Commit, CommitOk
+from accord_tpu.messages.apply_msg import Apply, ApplyOk
+from accord_tpu.messages.read import ReadTxnData, ReadOk, ReadNack
+
+__all__ = [
+    "Request", "Reply", "Callback", "SimpleReply",
+    "PreAccept", "PreAcceptOk", "PreAcceptNack",
+    "Accept", "AcceptOk", "AcceptNack",
+    "Commit", "CommitOk", "Apply", "ApplyOk",
+    "ReadTxnData", "ReadOk", "ReadNack",
+]

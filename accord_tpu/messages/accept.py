@@ -1,0 +1,69 @@
+"""Accept: ballot-protected slow-path executeAt proposal; returns deps up to
+executeAt so the coordinator can commit with a complete dep set
+(reference: messages/Accept.java:50)."""
+from __future__ import annotations
+
+from accord_tpu.local import commands
+from accord_tpu.local.commands import AcceptOutcome
+from accord_tpu.messages.base import Reply, Request
+from accord_tpu.primitives.deps import Deps
+from accord_tpu.primitives.keyspace import Seekables
+from accord_tpu.primitives.routes import Route
+from accord_tpu.primitives.timestamp import Ballot, Timestamp, TxnId
+
+
+class Accept(Request):
+    def __init__(self, txn_id: TxnId, ballot: Ballot, route: Route,
+                 keys: Seekables, execute_at: Timestamp):
+        self.txn_id = txn_id
+        self.ballot = ballot
+        self.route = route
+        self.keys = keys
+        self.execute_at = execute_at
+        self.wait_for_epoch = max(txn_id.epoch, execute_at.epoch)
+
+    def process(self, node, from_node, reply_context) -> None:
+        def map_fn(store):
+            outcome = commands.accept(store, self.txn_id, self.ballot, self.route,
+                                      store.owned(self.keys), self.execute_at)
+            if outcome == AcceptOutcome.REJECTED_BALLOT:
+                return AcceptNack(self.txn_id, store.command(self.txn_id).promised)
+            if outcome == AcceptOutcome.TRUNCATED:
+                return AcceptNack(self.txn_id, None)
+            deps = store.calculate_deps(self.txn_id, store.owned(self.keys),
+                                        self.execute_at)
+            return AcceptOk(self.txn_id, deps)
+
+        def reduce_fn(a, b):
+            if isinstance(a, AcceptNack) or isinstance(b, AcceptNack):
+                return a if isinstance(a, AcceptNack) else b
+            return AcceptOk(self.txn_id, a.deps.union(b.deps))
+
+        node.command_stores.map_reduce(self.keys, map_fn, reduce_fn) \
+            .on_success(lambda reply: node.reply(from_node, reply_context, reply)) \
+            .on_failure(node.agent.on_uncaught_exception)
+
+    def __repr__(self):
+        return f"Accept({self.txn_id!r}@{self.execute_at!r}, ballot={self.ballot!r})"
+
+
+class AcceptOk(Reply):
+    __slots__ = ("txn_id", "deps")
+
+    def __init__(self, txn_id: TxnId, deps: Deps):
+        self.txn_id = txn_id
+        self.deps = deps
+
+    def __repr__(self):
+        return f"AcceptOk({self.txn_id!r})"
+
+
+class AcceptNack(Reply):
+    __slots__ = ("txn_id", "promised")
+
+    def __init__(self, txn_id: TxnId, promised):
+        self.txn_id = txn_id
+        self.promised = promised
+
+    def __repr__(self):
+        return f"AcceptNack({self.txn_id!r}, promised={self.promised!r})"

@@ -1,0 +1,74 @@
+"""PreAccept: witness a txn and return (witnessedAt, deps)
+(reference: messages/PreAccept.java:37; handler logic :90-156)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from accord_tpu.local import commands
+from accord_tpu.local.commands import AcceptOutcome
+from accord_tpu.messages.base import Reply, Request
+from accord_tpu.primitives.deps import Deps
+from accord_tpu.primitives.routes import Route
+from accord_tpu.primitives.timestamp import Timestamp, TxnId
+from accord_tpu.primitives.txn import Txn
+
+
+class PreAccept(Request):
+    def __init__(self, txn_id: TxnId, txn: Txn, route: Route):
+        self.txn_id = txn_id
+        self.txn = txn
+        self.route = route
+        self.wait_for_epoch = txn_id.epoch
+
+    def process(self, node, from_node, reply_context) -> None:
+        def map_fn(store):
+            partial = self.txn.slice(store.ranges, include_query=False)
+            outcome = commands.preaccept(store, self.txn_id, partial, self.route)
+            if outcome == AcceptOutcome.REJECTED_BALLOT:
+                return PreAcceptNack(self.txn_id)
+            if outcome == AcceptOutcome.TRUNCATED:
+                return PreAcceptNack(self.txn_id)
+            cmd = store.command(self.txn_id)
+            witnessed = cmd.execute_at
+            deps = store.calculate_deps(self.txn_id, store.owned(self.txn.keys), witnessed)
+            return PreAcceptOk(self.txn_id, witnessed, deps)
+
+        def reduce_fn(a, b):
+            if isinstance(a, PreAcceptNack) or isinstance(b, PreAcceptNack):
+                return a if isinstance(a, PreAcceptNack) else b
+            # (reference: PreAcceptOk reduce, messages/PreAccept.java:141-156)
+            return PreAcceptOk(self.txn_id, max(a.witnessed_at, b.witnessed_at),
+                               a.deps.union(b.deps))
+
+        node.command_stores.map_reduce(self.txn.keys, map_fn, reduce_fn) \
+            .on_success(lambda reply: node.reply(from_node, reply_context, reply)) \
+            .on_failure(node.agent.on_uncaught_exception)
+
+    def __repr__(self):
+        return f"PreAccept({self.txn_id!r})"
+
+
+class PreAcceptOk(Reply):
+    __slots__ = ("txn_id", "witnessed_at", "deps")
+
+    def __init__(self, txn_id: TxnId, witnessed_at: Timestamp, deps: Deps):
+        self.txn_id = txn_id
+        self.witnessed_at = witnessed_at
+        self.deps = deps
+
+    @property
+    def is_fast_path_vote(self) -> bool:
+        return self.witnessed_at == self.txn_id
+
+    def __repr__(self):
+        return f"PreAcceptOk({self.txn_id!r}@{self.witnessed_at!r})"
+
+
+class PreAcceptNack(Reply):
+    __slots__ = ("txn_id",)
+
+    def __init__(self, txn_id: TxnId):
+        self.txn_id = txn_id
+
+    def __repr__(self):
+        return f"PreAcceptNack({self.txn_id!r})"

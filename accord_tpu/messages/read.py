@@ -1,0 +1,124 @@
+"""Execution-phase reads.
+
+Role-equivalent to the reference's ReadData/ReadTxnData
+(messages/ReadData.java:53): register as a transient listener on the command,
+wait until its local dependencies have applied (READY_TO_EXECUTE), then run
+the host Read against the DataStore at executeAt and reply with the Data.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from accord_tpu.local.command import TransientListener
+from accord_tpu.local.status import Status
+from accord_tpu.messages.base import Reply, Request
+from accord_tpu.primitives.keyspace import Keys
+from accord_tpu.primitives.timestamp import Timestamp, TxnId
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.utils.async_ import AsyncResult, all_of, success
+
+
+class ReadOk(Reply):
+    __slots__ = ("txn_id", "data")
+
+    def __init__(self, txn_id: TxnId, data):
+        self.txn_id = txn_id
+        self.data = data
+
+    def __repr__(self):
+        return f"ReadOk({self.txn_id!r})"
+
+
+class ReadNack(Reply):
+    __slots__ = ("txn_id",)
+
+    def __init__(self, txn_id: TxnId):
+        self.txn_id = txn_id
+
+    def __repr__(self):
+        return f"ReadNack({self.txn_id!r})"
+
+
+class _ReadWaiter(TransientListener):
+    """Waits for READY_TO_EXECUTE (deps applied) then performs this store's
+    slice of the read."""
+
+    def __init__(self, store, txn: Txn, execute_at: Timestamp, result: AsyncResult):
+        self.store = store
+        self.txn = txn
+        self.execute_at = execute_at
+        self.result = result
+
+    def on_change(self, store, command) -> None:
+        if self.result.done:
+            command.remove_transient_listener(self)
+            return
+        if command.is_(Status.INVALIDATED) or command.is_(Status.TRUNCATED):
+            command.remove_transient_listener(self)
+            self.result.try_set_failure(RuntimeError(f"{command.txn_id} invalidated"))
+            return
+        if command.is_ready_to_execute():
+            command.remove_transient_listener(self)
+            self.result.try_set_success(_do_read(self.store, self.txn, self.execute_at))
+
+
+def _do_read(store, txn: Txn, execute_at: Timestamp):
+    data = None
+    read_keys = txn.read.keys() if txn.read is not None else None
+    if read_keys is None:
+        return None
+    for key in store.owned(read_keys):
+        d = txn.read.read(key, store, execute_at)
+        if d is not None:
+            data = d if data is None else data.merge(d)
+    return data
+
+
+def _read_one_store(store, txn_id: TxnId, txn: Txn, execute_at: Timestamp) -> AsyncResult:
+    out: AsyncResult = AsyncResult()
+    cmd = store.command(txn_id)
+    if cmd.is_ready_to_execute():
+        out.set_success(_do_read(store, txn, execute_at))
+    elif cmd.is_(Status.INVALIDATED) or cmd.is_(Status.TRUNCATED):
+        out.set_failure(RuntimeError(f"{txn_id} invalidated"))
+    else:
+        cmd.add_transient_listener(_ReadWaiter(store, txn, execute_at, out))
+    return out
+
+
+def execute_read_when_ready(node, txn_id: TxnId, txn: Txn, execute_at: Timestamp,
+                            from_node, reply_context) -> None:
+    stores = node.command_stores.intersecting(txn.keys)
+    waits = [_read_one_store(s, txn_id, txn, execute_at) for s in stores]
+
+    def merge(datas):
+        data = None
+        for d in datas:
+            if d is not None:
+                data = d if data is None else data.merge(d)
+        node.reply(from_node, reply_context, ReadOk(txn_id, data))
+
+    all_of(waits).on_success(merge) \
+        .on_failure(lambda _: node.reply(from_node, reply_context, ReadNack(txn_id)))
+
+
+class ReadTxnData(Request):
+    """Standalone read request (retry path when the committing replica's
+    embedded read failed or a different replica is tried)."""
+
+    def __init__(self, txn_id: TxnId, txn: Txn, execute_at: Timestamp):
+        self.txn_id = txn_id
+        self.txn = txn
+        self.execute_at = execute_at
+        self.wait_for_epoch = max(txn_id.epoch, execute_at.epoch)
+
+    @property
+    def has_side_effects(self) -> bool:
+        return False
+
+    def process(self, node, from_node, reply_context) -> None:
+        execute_read_when_ready(node, self.txn_id, self.txn, self.execute_at,
+                                from_node, reply_context)
+
+    def __repr__(self):
+        return f"ReadTxnData({self.txn_id!r})"

@@ -207,7 +207,7 @@ class Ranges:
     merges overlapping/adjacent-equal ranges so the invariant is
     'sorted by start, non-overlapping'."""
 
-    __slots__ = ("_ranges",)
+    __slots__ = ("_ranges", "_starts")
     domain = Domain.RANGE
 
     def __init__(self, ranges: Iterable[Range] = (), *, _normalized: Optional[Tuple[Range, ...]] = None):
@@ -215,6 +215,7 @@ class Ranges:
             self._ranges = _normalized
         else:
             self._ranges = _normalize(list(ranges))
+        self._starts = tuple(r.start for r in self._ranges)
 
     @classmethod
     def of(cls, *ranges: Range) -> "Ranges":
@@ -246,7 +247,7 @@ class Ranges:
         return not self._ranges
 
     def contains_key(self, key: Key) -> bool:
-        i = bisect_right([r.start for r in self._ranges], key) - 1
+        i = bisect_right(self._starts, key) - 1
         return i >= 0 and self._ranges[i].contains(key)
 
     def contains_ranges(self, other: "Ranges") -> bool:

@@ -52,16 +52,16 @@ class TxnKind(enum.IntEnum):
     def witnesses(self, other: "TxnKind") -> bool:
         """Does a txn of kind `self` include a conflicting txn of kind `other`
         in its deps? Reads witness only writes; writes and sync points witness
-        reads and writes."""
-        w = _WITNESSES[self]
-        return other in w
+        reads and writes; exclusive sync points witness every globally visible
+        kind (reference: Txn.Kind.witnesses, primitives/Txn.java:224-236)."""
+        return other in _WITNESSES[self]
 
     def witnessed_by(self, other: "TxnKind") -> bool:
         return self in _WITNESSES[other]
 
     @property
     def is_write(self) -> bool:
-        return self is TxnKind.WRITE or self is TxnKind.EXCLUSIVE_SYNC_POINT
+        return self is TxnKind.WRITE
 
     @property
     def is_read(self) -> bool:
@@ -72,20 +72,30 @@ class TxnKind(enum.IntEnum):
         return self in (TxnKind.SYNC_POINT, TxnKind.EXCLUSIVE_SYNC_POINT)
 
     @property
+    def awaits_only_deps(self) -> bool:
+        """Executes only after its deps, with no logical executeAt
+        (reference: Txn.Kind.awaitsOnlyDeps)."""
+        return self in (TxnKind.EXCLUSIVE_SYNC_POINT, TxnKind.EPHEMERAL_READ)
+
+    @property
     def is_durable(self) -> bool:
         """Ephemeral reads leave no durable state."""
         return self is not TxnKind.EPHEMERAL_READ
 
 
-_RW = frozenset({TxnKind.READ, TxnKind.WRITE, TxnKind.SYNC_POINT,
-                 TxnKind.EXCLUSIVE_SYNC_POINT})
-_W = frozenset({TxnKind.WRITE, TxnKind.EXCLUSIVE_SYNC_POINT})
+# Exact mirror of the reference's witnesses() table (primitives/Txn.java:224):
+#   Read/EphemeralRead -> Ws; Write/SyncPoint -> RsOrWs;
+#   ExclusiveSyncPoint -> AnyGloballyVisible.
+_RW = frozenset({TxnKind.READ, TxnKind.WRITE})
+_W = frozenset({TxnKind.WRITE})
+_ANY_GLOBALLY_VISIBLE = frozenset({TxnKind.READ, TxnKind.WRITE,
+                                   TxnKind.SYNC_POINT, TxnKind.EXCLUSIVE_SYNC_POINT})
 _WITNESSES = {
     TxnKind.READ: _W,
     TxnKind.EPHEMERAL_READ: _W,
     TxnKind.WRITE: _RW,
     TxnKind.SYNC_POINT: _RW,
-    TxnKind.EXCLUSIVE_SYNC_POINT: _RW,
+    TxnKind.EXCLUSIVE_SYNC_POINT: _ANY_GLOBALLY_VISIBLE,
     TxnKind.LOCAL_ONLY: frozenset(),
 }
 
@@ -217,7 +227,11 @@ class TxnId(Timestamp):
 
 
 TxnId.NONE = TxnId(0, 0, 0, 0)
-TxnId.MAX = TxnId.from_timestamp(Timestamp.MAX)
+# MAX sentinel keeps a VALID kind/domain encoding (LOCAL_ONLY + RANGE) so that
+# .kind/.domain/repr never crash; no real TxnId carries higher flag bits, so
+# it still compares above every real id at equal (epoch, hlc).
+TxnId.MAX = TxnId.create((1 << _EPOCH_BITS) - 1, (1 << _HLC_BITS) - 1,
+                         (1 << _NODE_BITS) - 1, TxnKind.LOCAL_ONLY, Domain.RANGE)
 
 
 class Ballot(Timestamp):

@@ -73,13 +73,24 @@ class PartialTxn(Txn):
         return self.covering.contains_ranges(ranges)
 
     def union(self, other: "PartialTxn") -> "PartialTxn":
+        """Merge two slices (reference: PartialTxn.java:70-72 -- read/update
+        are MERGED, not first-wins, or the second slice's coverage is lost)."""
+        assert self.kind == other.kind, f"kind mismatch {self.kind} vs {other.kind}"
         return PartialTxn(
             self.kind, self.keys.union(other.keys),
             covering=self.covering.union(other.covering),
-            read=self.read if self.read is not None else other.read,
-            update=self.update if self.update is not None else other.update,
+            read=_merge_part(self.read, other.read),
+            update=_merge_part(self.update, other.update),
             query=self.query if self.query is not None else other.query,
         )
 
     def reconstitute(self) -> Txn:
         return Txn(self.kind, self.keys, self.read, self.update, self.query)
+
+
+def _merge_part(a, b):
+    if a is None:
+        return b
+    if b is None or a is b:
+        return a
+    return a.merge(b)

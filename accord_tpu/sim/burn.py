@@ -1,0 +1,149 @@
+"""The burn test: seeded random workload against a simulated cluster with
+strict-serializability verification.
+
+Role-equivalent to the reference's BurnTest (test burn/BurnTest.java:107):
+generate ~N random read/read-write transactions over a hash-key domain, drive
+them through randomly chosen coordinators with bounded concurrency on the
+single-threaded logical clock, verify every ack'd result, then check replica
+convergence and final-state consistency at quiescence.
+
+CLI:  python -m accord_tpu.sim.burn --seed 1 --ops 1000 [--nodes 3]
+      [--count K]  run K consecutive seeds
+      [--reconcile] run each seed twice and require identical event logs
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from accord_tpu.primitives.keyspace import Keys
+from accord_tpu.primitives.timestamp import Domain, TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.sim.cluster import Cluster, ClusterConfig
+from accord_tpu.sim.list_store import ListQuery, ListRead, ListResult, ListUpdate
+from accord_tpu.sim.verifier import StrictSerializabilityVerifier
+from accord_tpu.utils.rng import RandomSource
+
+
+class BurnReport:
+    def __init__(self):
+        self.acked = 0
+        self.failed = 0
+        self.lost = 0       # submitted but never completed (should be 0 at quiescence)
+        self.events = 0
+        self.elapsed_sim_ms = 0.0
+        self.log: List[str] = []
+
+    def as_dict(self) -> dict:
+        return {"acked": self.acked, "failed": self.failed, "lost": self.lost,
+                "events": self.events, "elapsed_sim_ms": self.elapsed_sim_ms}
+
+
+def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
+             key_count: int = 32, concurrency: int = 8,
+             write_ratio: float = 0.7, max_keys_per_txn: int = 3,
+             config: Optional[ClusterConfig] = None,
+             collect_log: bool = False) -> BurnReport:
+    cfg = config or ClusterConfig(num_nodes=nodes, rf=rf)
+    cluster = Cluster(seed, cfg)
+    wl_rng = cluster.rng.fork()
+    verifier = StrictSerializabilityVerifier()
+    report = BurnReport()
+    state = {"submitted": 0, "completed": 0, "next_value": 1}
+
+    # keys drawn zipfian from a small hot set spread over the hash domain
+    key_space = sorted(wl_rng.sample(range(cfg.key_domain), key_count))
+
+    def gen_txn() -> Tuple[Txn, Optional[int], Dict]:
+        nkeys = wl_rng.next_int_between(1, max_keys_per_txn + 1)
+        chosen = Keys(wl_rng.pick(key_space) for _ in range(nkeys))
+        is_write = wl_rng.decide(write_ratio)
+        read = ListRead(chosen)
+        if is_write:
+            value = state["next_value"]
+            state["next_value"] += 1
+            update = ListUpdate(chosen, value)
+            txn = Txn(TxnKind.WRITE, chosen, read=read, update=update,
+                      query=ListQuery())
+            return txn, value, {k: value for k in chosen}
+        return Txn(TxnKind.READ, chosen, read=read, query=ListQuery()), None, {}
+
+    def submit():
+        if state["submitted"] >= ops:
+            return
+        state["submitted"] += 1
+        txn, value, writes = gen_txn()
+        node = cluster.nodes[1 + wl_rng.next_int(cfg.num_nodes)]
+        start_us = cluster.queue.now_micros
+        if value is not None:
+            verifier.on_issue_write(value, start_us)
+
+        def complete(result, failure):
+            state["completed"] += 1
+            end_us = cluster.queue.now_micros
+            if failure is None:
+                report.acked += 1
+                assert isinstance(result, ListResult)
+                verifier.witness(start_us, end_us, result.reads, writes)
+                if collect_log:
+                    report.log.append(
+                        f"{end_us} ack {result.txn_id} reads={sorted(result.reads.items())} w={value}")
+            else:
+                report.failed += 1
+                if collect_log:
+                    report.log.append(f"{end_us} fail {type(failure).__name__} w={value}")
+            # keep the pipeline full
+            cluster.queue.add(wl_rng.next_int(5_000), submit)
+
+        node.coordinate(txn).add_callback(complete)
+
+    # kick off with bounded concurrency
+    for i in range(min(concurrency, ops)):
+        cluster.queue.add(wl_rng.next_int(20_000), submit)
+
+    report.events = cluster.drain(max_events=ops * 4000)
+    report.elapsed_sim_ms = (cluster.queue.now_micros - 1_000_000) / 1000.0
+    report.lost = state["submitted"] - state["completed"]
+
+    cluster.check_no_failures()
+    verifier.check_final_state(cluster.converged_key_lists())
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="accord_tpu burn test")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--ops", type=int, default=1000)
+    ap.add_argument("--count", type=int, default=1, help="number of seeds to run")
+    ap.add_argument("--nodes", type=int, default=3)
+    ap.add_argument("--rf", type=int, default=3)
+    ap.add_argument("--keys", type=int, default=32)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--reconcile", action="store_true",
+                    help="run each seed twice; require identical logs")
+    args = ap.parse_args(argv)
+
+    ok = True
+    for seed in range(args.seed, args.seed + args.count):
+        kwargs = dict(ops=args.ops, nodes=args.nodes, rf=args.rf,
+                      key_count=args.keys, concurrency=args.concurrency)
+        try:
+            r = run_burn(seed, collect_log=args.reconcile, **kwargs)
+            if args.reconcile:
+                r2 = run_burn(seed, collect_log=True, **kwargs)
+                if r.log != r2.log:
+                    print(f"seed {seed}: NON-DETERMINISTIC ({len(r.log)} vs {len(r2.log)} entries)")
+                    ok = False
+                    continue
+            print(json.dumps({"seed": seed, **r.as_dict(),
+                              "deterministic": args.reconcile or None}))
+        except AssertionError as e:
+            print(f"seed {seed}: FAILED: {e}")
+            ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

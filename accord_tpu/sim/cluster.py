@@ -1,0 +1,146 @@
+"""Whole-cluster assembly for simulation.
+
+Role-equivalent to the reference's test Cluster (test impl/basic/
+Cluster.java:374-447): builds N Nodes wired to one PendingQueue-backed
+network/scheduler/clock, a static sharded topology over an integer hash-key
+domain, list-store storage and a collecting agent.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from accord_tpu.api import Agent, ConfigurationService
+from accord_tpu.local.node import Node
+from accord_tpu.primitives.keyspace import Range, Ranges
+from accord_tpu.primitives.timestamp import NodeId
+from accord_tpu.sim.list_store import ListStore
+from accord_tpu.sim.network import SimNetwork
+from accord_tpu.sim.queue import PendingQueue
+from accord_tpu.sim.scheduler import SimScheduler, SimTimeService
+from accord_tpu.topology.shard import Shard
+from accord_tpu.topology.topology import Topology
+from accord_tpu.utils.rng import RandomSource
+
+
+class ClusterConfig:
+    def __init__(self, num_nodes: int = 3, rf: int = 3, num_shards: int = 4,
+                 key_domain: int = 1 << 16, stores_per_node: int = 2,
+                 timeout_ms: float = 1000.0):
+        self.num_nodes = num_nodes
+        self.rf = min(rf, num_nodes)
+        self.num_shards = num_shards
+        self.key_domain = key_domain
+        self.stores_per_node = stores_per_node
+        self.timeout_ms = timeout_ms
+
+
+def build_topology(cfg: ClusterConfig, epoch: int = 1) -> Topology:
+    """Split [0, key_domain) into num_shards ranges; assign rf replicas
+    round-robin (the reference burn test's initial topology shape)."""
+    width = cfg.key_domain // cfg.num_shards
+    shards = []
+    for i in range(cfg.num_shards):
+        start = i * width
+        end = cfg.key_domain if i == cfg.num_shards - 1 else (i + 1) * width
+        nodes = [1 + (i + j) % cfg.num_nodes for j in range(cfg.rf)]
+        shards.append(Shard(Range(start, end), nodes))
+    return Topology(epoch, shards)
+
+
+class SimConfigService(ConfigurationService):
+    def __init__(self, topology: Topology):
+        self._topologies = {topology.epoch: topology}
+        self._current = topology
+
+    def current_topology(self) -> Topology:
+        return self._current
+
+    def get_topology_for_epoch(self, epoch: int) -> Optional[Topology]:
+        return self._topologies.get(epoch)
+
+    def add(self, topology: Topology) -> None:
+        self._topologies[topology.epoch] = topology
+        if topology.epoch > self._current.epoch:
+            self._current = topology
+
+
+class SimAgent(Agent):
+    """Collects failures instead of crashing the loop; tests assert empty."""
+
+    def __init__(self, cluster: "Cluster", node_id: NodeId):
+        self.cluster = cluster
+        self.node_id = node_id
+
+    def on_uncaught_exception(self, failure: BaseException) -> None:
+        self.cluster.failures.append((self.node_id, failure))
+
+    def on_inconsistent_timestamp(self, command, prev, next_ts) -> None:
+        self.cluster.failures.append(
+            (self.node_id, AssertionError(
+                f"inconsistent timestamp for {command}: {prev} vs {next_ts}")))
+
+
+class Cluster:
+    def __init__(self, seed: int, config: Optional[ClusterConfig] = None):
+        self.config = config or ClusterConfig()
+        self.rng = RandomSource(seed)
+        self.queue = PendingQueue()
+        self.network = SimNetwork(self.queue, self.rng.fork(),
+                                  timeout_ms=self.config.timeout_ms)
+        self.scheduler = SimScheduler(self.queue)
+        self.time_service = SimTimeService(self.queue)
+        self.topology = build_topology(self.config)
+        self.failures: List = []
+        self.nodes: Dict[NodeId, Node] = {}
+        self.stores: Dict[NodeId, ListStore] = {}
+        for node_id in range(1, self.config.num_nodes + 1):
+            store = ListStore()
+            node = Node(
+                node_id,
+                message_sink=self.network.sink_for(node_id),
+                config_service=SimConfigService(self.topology),
+                scheduler=self.scheduler,
+                agent=SimAgent(self, node_id),
+                rng=self.rng.fork(),
+                time_service=self.time_service,
+                data_store=store,
+                num_stores=self.config.stores_per_node,
+            )
+            self.nodes[node_id] = node
+            self.stores[node_id] = store
+            self.network.register_node(node)
+
+    def node(self, node_id: NodeId) -> Node:
+        return self.nodes[node_id]
+
+    def any_node(self) -> Node:
+        return self.nodes[self.rng.pick(sorted(self.nodes))]
+
+    def drain(self, max_events: Optional[int] = None) -> int:
+        return self.queue.drain(max_events)
+
+    def check_no_failures(self) -> None:
+        if self.failures:
+            node_id, failure = self.failures[0]
+            raise AssertionError(
+                f"{len(self.failures)} node failure(s); first on node {node_id}: "
+                f"{failure!r}") from failure
+
+    def converged_key_lists(self) -> Dict[object, tuple]:
+        """At quiescence every replica of a key must hold the same list;
+        returns the authoritative map (and asserts convergence)."""
+        out: Dict[object, tuple] = {}
+        for node_id, store in self.stores.items():
+            owned = self.topology.ranges_for_node(node_id)
+            for key, entries in store.data.items():
+                if not owned.contains_key(key):
+                    continue
+                lst = tuple(v for _, v in entries)
+                if key in out:
+                    if out[key] != lst:
+                        raise AssertionError(
+                            f"replica divergence on key {key}: {out[key]} vs "
+                            f"{lst} (node {node_id})")
+                else:
+                    out[key] = lst
+        return out

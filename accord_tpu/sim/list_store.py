@@ -1,0 +1,123 @@
+"""The list-store workload oracle.
+
+Role-equivalent to the reference's impl/list model (test impl/list/
+ListStore.java, ListRead/ListUpdate/ListQuery/ListResult): each key holds an
+append-only list of unique ints; writes append their value at executeAt,
+reads return the list as of executeAt. Because values are unique and appends
+are totally ordered by executeAt, observed lists directly expose the
+serialization order for the verifier.
+"""
+from __future__ import annotations
+
+from bisect import insort
+from typing import Dict, Optional, Tuple
+
+from accord_tpu import api
+from accord_tpu.primitives.keyspace import Keys, Ranges, Seekables
+from accord_tpu.primitives.timestamp import Timestamp, TxnId
+
+
+class ListData(api.Data):
+    def __init__(self, entries: Dict[object, Tuple[int, ...]]):
+        self.entries = dict(entries)
+
+    def merge(self, other: "ListData") -> "ListData":
+        merged = dict(self.entries)
+        for k, v in other.entries.items():
+            if k not in merged or len(v) > len(merged[k]):
+                merged[k] = v
+        return ListData(merged)
+
+    def __repr__(self):
+        return f"ListData({self.entries!r})"
+
+
+class ListStore(api.DataStore):
+    """Per-node storage: key -> sorted list of (executeAt, value)."""
+
+    def __init__(self):
+        self.data: Dict[object, list] = {}
+
+    def read_at(self, key, at: Timestamp) -> Tuple[int, ...]:
+        entries = self.data.get(key, [])
+        return tuple(v for ts, v in entries if ts < at)
+
+    def append(self, key, at: Timestamp, value: int) -> None:
+        entries = self.data.setdefault(key, [])
+        insort(entries, (at, value))
+
+    def snapshot(self, key) -> Tuple[int, ...]:
+        return tuple(v for _, v in self.data.get(key, []))
+
+
+class ListRead(api.Read):
+    def __init__(self, keys: Keys):
+        self._keys = keys
+
+    def keys(self) -> Keys:
+        return self._keys
+
+    def read(self, key, store, execute_at: Timestamp) -> Optional[ListData]:
+        data_store: ListStore = store.node.data_store
+        return ListData({key: data_store.read_at(key, execute_at)})
+
+    def slice(self, ranges: Ranges) -> "ListRead":
+        return ListRead(self._keys.slice(ranges))
+
+    def merge(self, other: "ListRead") -> "ListRead":
+        return ListRead(self._keys.union(other._keys))
+
+
+class ListWrite(api.Write):
+    def __init__(self, appends: Dict[object, int]):
+        self.appends = appends
+
+    def apply(self, key, store, execute_at: Timestamp) -> None:
+        if key in self.appends:
+            data_store: ListStore = store.node.data_store
+            data_store.append(key, execute_at, self.appends[key])
+
+
+class ListUpdate(api.Update):
+    """Append `value` to each key in keys."""
+
+    def __init__(self, keys: Keys, value: int):
+        self._keys = keys
+        self.value = value
+
+    def keys(self) -> Keys:
+        return self._keys
+
+    def apply(self, execute_at: Timestamp, data) -> ListWrite:
+        return ListWrite({k: self.value for k in self._keys})
+
+    def slice(self, ranges: Ranges) -> "ListUpdate":
+        return ListUpdate(self._keys.slice(ranges), self.value)
+
+    def merge(self, other: "ListUpdate") -> "ListUpdate":
+        assert self.value == other.value
+        return ListUpdate(self._keys.union(other._keys), self.value)
+
+
+class ListResult(api.Result):
+    def __init__(self, txn_id: TxnId, execute_at: Timestamp,
+                 reads: Dict[object, Tuple[int, ...]], write_value: Optional[int]):
+        self.txn_id = txn_id
+        self.execute_at = execute_at
+        self.reads = reads
+        self.write_value = write_value
+
+    def __repr__(self):
+        return f"ListResult({self.txn_id!r}, reads={self.reads!r}, w={self.write_value})"
+
+
+class ListQuery(api.Query):
+    def compute(self, txn_id: TxnId, execute_at: Timestamp, keys, data,
+                read, update) -> ListResult:
+        reads = dict(data.entries) if data is not None else {}
+        # ensure every read key reports (possibly-empty) observations
+        if read is not None:
+            for k in read.keys():
+                reads.setdefault(k, ())
+        return ListResult(txn_id, execute_at, reads,
+                          update.value if update is not None else None)

@@ -1,0 +1,149 @@
+"""Simulated network: per-link latency, drops, partitions, reply demux and
+timeouts.
+
+Role-equivalent to the reference's NodeSink (test impl/basic/NodeSink.java:42)
+with its per-link Action {DELIVER, DROP, DELIVER_WITH_FAILURE, FAILURE} and
+the periodically re-randomized link topology (Cluster.Link). One SimNetwork is
+shared by the cluster; each node gets a SimMessageSink facade bound to its id.
+"""
+from __future__ import annotations
+
+import enum
+import itertools
+from typing import Callable, Dict, Optional, Tuple
+
+from accord_tpu.api import MessageSink
+from accord_tpu.messages.base import Callback, Timeout
+from accord_tpu.primitives.timestamp import NodeId
+from accord_tpu.sim.queue import PendingQueue
+from accord_tpu.utils.rng import RandomSource
+
+
+class ReplyContext:
+    __slots__ = ("origin", "msg_id")
+
+    def __init__(self, origin: NodeId, msg_id: int):
+        self.origin = origin
+        self.msg_id = msg_id
+
+
+class LinkConfig:
+    """Behaviour of the from->to link at a point in time."""
+
+    __slots__ = ("min_latency_us", "max_latency_us", "drop_probability")
+
+    def __init__(self, min_latency_us: int = 500, max_latency_us: int = 20_000,
+                 drop_probability: float = 0.0):
+        self.min_latency_us = min_latency_us
+        self.max_latency_us = max_latency_us
+        self.drop_probability = drop_probability
+
+
+class SimNetwork:
+    def __init__(self, queue: PendingQueue, rng: RandomSource,
+                 timeout_ms: float = 1000.0):
+        self.queue = queue
+        self.rng = rng
+        self.timeout_ms = timeout_ms
+        self.nodes: Dict[NodeId, object] = {}  # node_id -> Node
+        self._msg_ids = itertools.count(1)
+        # msg_id -> (callback, replier may be any node, timeout handle)
+        self._pending: Dict[int, Tuple[Callback, object]] = {}
+        self._default_link = LinkConfig()
+        self._links: Dict[Tuple[NodeId, NodeId], LinkConfig] = {}
+        self.partitioned: set = set()  # set of frozenset({a, b}) pairs cut off
+        self.stats: Dict[str, int] = {"sent": 0, "delivered": 0, "dropped": 0,
+                                      "timeouts": 0, "replies": 0}
+
+    def register_node(self, node) -> None:
+        self.nodes[node.id] = node
+
+    def sink_for(self, node_id: NodeId) -> "SimMessageSink":
+        return SimMessageSink(self, node_id)
+
+    def link(self, a: NodeId, b: NodeId) -> LinkConfig:
+        return self._links.get((a, b), self._default_link)
+
+    def set_link(self, a: NodeId, b: NodeId, config: LinkConfig) -> None:
+        self._links[(a, b)] = config
+
+    def set_partitioned(self, a: NodeId, b: NodeId, partitioned: bool) -> None:
+        pair = frozenset((a, b))
+        if partitioned:
+            self.partitioned.add(pair)
+        else:
+            self.partitioned.discard(pair)
+
+    # -- transport -----------------------------------------------------------
+    def _should_drop(self, src: NodeId, dst: NodeId) -> bool:
+        if src == dst:
+            return False
+        if frozenset((src, dst)) in self.partitioned:
+            return True
+        return self.rng.decide(self.link(src, dst).drop_probability)
+
+    def _latency(self, src: NodeId, dst: NodeId) -> int:
+        if src == dst:
+            return self.rng.next_int_between(50, 500)
+        cfg = self.link(src, dst)
+        return self.rng.next_int_between(cfg.min_latency_us, cfg.max_latency_us)
+
+    def send_request(self, src: NodeId, dst: NodeId, request,
+                     callback: Optional[Callback]) -> None:
+        self.stats["sent"] += 1
+        msg_id = next(self._msg_ids)
+        if callback is not None:
+            timeout_handle = self.queue.add(
+                int(self.timeout_ms * 1000),
+                lambda: self._on_timeout(msg_id, dst))
+            self._pending[msg_id] = (callback, timeout_handle)
+        if self._should_drop(src, dst):
+            self.stats["dropped"] += 1
+            return
+        ctx = ReplyContext(src, msg_id)
+        node = self.nodes[dst]
+        self.queue.add(self._latency(src, dst),
+                       lambda: (self._count("delivered"),
+                                node.receive(request, src, ctx)))
+
+    def send_reply(self, src: NodeId, ctx: ReplyContext, reply) -> None:
+        self.stats["replies"] += 1
+        if self._should_drop(src, ctx.origin):
+            self.stats["dropped"] += 1
+            return
+        self.queue.add(self._latency(src, ctx.origin),
+                       lambda: self._deliver_reply(src, ctx, reply))
+
+    def _deliver_reply(self, src: NodeId, ctx: ReplyContext, reply) -> None:
+        entry = self._pending.pop(ctx.msg_id, None)
+        if entry is None:
+            return  # no callback registered or already timed out
+        callback, timeout_handle = entry
+        timeout_handle.cancel()
+        callback.on_success(src, reply)
+
+    def _on_timeout(self, msg_id: int, dst: NodeId) -> None:
+        entry = self._pending.pop(msg_id, None)
+        if entry is None:
+            return
+        self.stats["timeouts"] += 1
+        callback, _ = entry
+        callback.on_failure(dst, Timeout(f"no reply from {dst}"))
+
+    def _count(self, key: str) -> None:
+        self.stats[key] += 1
+
+
+class SimMessageSink(MessageSink):
+    def __init__(self, network: SimNetwork, node_id: NodeId):
+        self.network = network
+        self.node_id = node_id
+
+    def send(self, to: NodeId, request) -> None:
+        self.network.send_request(self.node_id, to, request, None)
+
+    def send_with_callback(self, to: NodeId, request, callback: Callback) -> None:
+        self.network.send_request(self.node_id, to, request, callback)
+
+    def reply(self, to: NodeId, reply_context: ReplyContext, reply) -> None:
+        self.network.send_reply(self.node_id, reply_context, reply)

@@ -1,0 +1,69 @@
+"""The single logical clock driving a simulated cluster.
+
+Role-equivalent to the reference's RandomDelayQueue + PropagatingPendingQueue
+(test impl/basic/RandomDelayQueue.java): a priority queue of (time, seq, fn)
+events; seq breaks ties so execution order is fully deterministic.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Callable, List, Optional, Tuple
+
+
+class Cancellable:
+    __slots__ = ("cancelled",)
+
+    def __init__(self):
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+
+class PendingQueue:
+    def __init__(self, start_micros: int = 1_000_000):
+        self._heap: List[Tuple[int, int, Cancellable, Callable[[], None]]] = []
+        self._seq = itertools.count()
+        self.now_micros = start_micros
+
+    def add(self, delay_micros: int, fn: Callable[[], None]) -> Cancellable:
+        assert delay_micros >= 0
+        handle = Cancellable()
+        heapq.heappush(self._heap, (self.now_micros + int(delay_micros),
+                                    next(self._seq), handle, fn))
+        return handle
+
+    def add_at(self, at_micros: int, fn: Callable[[], None]) -> Cancellable:
+        return self.add(max(0, at_micros - self.now_micros), fn)
+
+    def is_empty(self) -> bool:
+        return not self._heap
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def process_one(self) -> bool:
+        """Pop and run the next event; returns False when drained."""
+        while self._heap:
+            at, _, handle, fn = heapq.heappop(self._heap)
+            if handle.cancelled:
+                continue
+            self.now_micros = max(self.now_micros, at)
+            fn()
+            return True
+        return False
+
+    def process_until(self, deadline_micros: int) -> None:
+        while self._heap and self._heap[0][0] <= deadline_micros:
+            if not self.process_one():
+                break
+        self.now_micros = max(self.now_micros, deadline_micros)
+
+    def drain(self, max_events: Optional[int] = None) -> int:
+        n = 0
+        while self.process_one():
+            n += 1
+            if max_events is not None and n >= max_events:
+                break
+        return n

@@ -1,0 +1,43 @@
+"""Simulated Scheduler and TimeService over the shared PendingQueue
+(reference: the test Cluster itself implements Scheduler; clock drift per node
+comes with the fault-injection milestone)."""
+from __future__ import annotations
+
+from typing import Callable
+
+from accord_tpu.api import Scheduler
+from accord_tpu.local.node import TimeService
+from accord_tpu.sim.queue import Cancellable, PendingQueue
+
+
+class SimScheduler(Scheduler):
+    def __init__(self, queue: PendingQueue):
+        self.queue = queue
+
+    def once(self, delay_ms: float, fn: Callable[[], None]) -> Cancellable:
+        return self.queue.add(int(delay_ms * 1000), fn)
+
+    def recurring(self, interval_ms: float, fn: Callable[[], None]) -> Cancellable:
+        handle = Cancellable()
+
+        def tick():
+            if handle.cancelled:
+                return
+            fn()
+            self.queue.add(int(interval_ms * 1000), tick)
+
+        self.queue.add(int(interval_ms * 1000), tick)
+        return handle
+
+    def now(self, fn: Callable[[], None]) -> None:
+        # run immediately: preserves the reference's semantics of executing on
+        # the event loop without further delay, and keeps causal ordering
+        fn()
+
+
+class SimTimeService(TimeService):
+    def __init__(self, queue: PendingQueue):
+        self.queue = queue
+
+    def now_micros(self) -> int:
+        return self.queue.now_micros

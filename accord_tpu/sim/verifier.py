@@ -1,0 +1,139 @@
+"""Strict-serializability checking for the list-store workload.
+
+Role-equivalent to the reference's StrictSerializabilityVerifier
+(test verify/StrictSerializabilityVerifier.java:58). Because every write
+appends a globally unique value and each key's list is the serialization
+order of its writes, observed reads expose per-key orders directly. We check:
+
+  1. per-key order consistency: all observed sequences for a key are
+     prefixes of one total order;
+  2. read-own-write exclusion: a txn never observes its own append;
+  3. real-time (strict) ordering: if txn A completed before txn B started,
+     B observes at least everything A observed (per key), and every key A
+     (ack'd) wrote is visible to B's reads of that key;
+  4. no reads from the future: observed values must belong to writes that
+     were issued before the reader completed.
+
+Unknown-outcome txns (client timeouts) register their values as "maybe":
+allowed to appear, never required.
+"""
+from __future__ import annotations
+
+from bisect import bisect_left, insort
+from typing import Dict, List, Optional, Tuple
+
+
+class HistoryViolation(AssertionError):
+    pass
+
+
+class _KeyHistory:
+    __slots__ = ("order", "read_marks", "write_marks")
+
+    def __init__(self):
+        self.order: Tuple[int, ...] = ()   # longest observed sequence
+        # (end_us, seq_len) for completed reads, append-ordered by end_us
+        self.read_marks: List[Tuple[int, int]] = []
+        # (end_us, value) for ack'd writes, append-ordered by end_us
+        self.write_marks: List[Tuple[int, int]] = []
+
+
+class StrictSerializabilityVerifier:
+    def __init__(self):
+        self._keys: Dict[object, _KeyHistory] = {}
+        self._issued: Dict[int, int] = {}    # value -> issue (start) time
+        self._acked: set = set()             # values of ack'd writes
+        self.witnessed = 0
+
+    def _key(self, key) -> _KeyHistory:
+        h = self._keys.get(key)
+        if h is None:
+            h = _KeyHistory()
+            self._keys[key] = h
+        return h
+
+    # -- workload bookkeeping ------------------------------------------------
+    def on_issue_write(self, value: int, start_us: int) -> None:
+        self._issued[value] = start_us
+
+    # -- the main check ------------------------------------------------------
+    def witness(self, start_us: int, end_us: int,
+                reads: Dict[object, Tuple[int, ...]],
+                writes: Dict[object, int]) -> None:
+        """Called at client completion of an ack'd txn."""
+        self.witnessed += 1
+        for key, seq in reads.items():
+            h = self._key(key)
+            own = writes.get(key)
+            if own is not None and own in seq:
+                raise HistoryViolation(
+                    f"txn observed its own write {own} on key {key}: {seq}")
+            for v in seq:
+                if v not in self._issued:
+                    raise HistoryViolation(f"key {key}: read unknown value {v}")
+                if self._issued[v] > end_us:
+                    raise HistoryViolation(
+                        f"key {key}: value {v} read before it was issued")
+            self._check_prefix(key, h, seq)
+            # real-time read monotonicity: longest seq observed by any txn
+            # that completed before we started must be a prefix of ours
+            required = self._max_len_before(h.read_marks, start_us)
+            if len(seq) < required:
+                raise HistoryViolation(
+                    f"key {key}: read of len {len(seq)} ({seq}) missing writes "
+                    f"observed by a txn completed before this one started "
+                    f"(required >= {required}; order={h.order})")
+            # real-time write visibility: ack'd writes completed before our
+            # start must be visible
+            seq_set = set(seq)
+            for w_end, w_val in h.write_marks:
+                if w_end >= start_us:
+                    break
+                if w_val not in seq_set:
+                    raise HistoryViolation(
+                        f"key {key}: ack'd write {w_val} (completed {w_end}us) "
+                        f"not visible to read started {start_us}us: {seq}")
+            h.read_marks.append((end_us, len(seq)))
+        for key, value in writes.items():
+            self._acked.add(value)
+            self._key(key).write_marks.append((end_us, value))
+
+    def _check_prefix(self, key, h: _KeyHistory, seq: Tuple[int, ...]) -> None:
+        n = min(len(seq), len(h.order))
+        if seq[:n] != h.order[:n]:
+            raise HistoryViolation(
+                f"key {key}: divergent orders {seq} vs {h.order}")
+        if len(seq) > len(h.order):
+            h.order = tuple(seq)
+
+    @staticmethod
+    def _max_len_before(marks: List[Tuple[int, int]], start_us: int) -> int:
+        best = 0
+        for end, ln in marks:
+            if end >= start_us:
+                break
+            if ln > best:
+                best = ln
+        return best
+
+    # -- final (quiescent) checks --------------------------------------------
+    def check_final_state(self, key_lists: Dict[object, Tuple[int, ...]]) -> None:
+        """At quiescence, the authoritative per-key lists must extend the
+        observed orders, and every ack'd write must be present somewhere."""
+        present = set()
+        for key, final in key_lists.items():
+            h = self._keys.get(key)
+            if h is not None:
+                n = min(len(final), len(h.order))
+                if final[:n] != h.order[:n]:
+                    raise HistoryViolation(
+                        f"key {key}: final list {final} diverges from observed "
+                        f"order {h.order}")
+                if len(final) < len(h.order):
+                    raise HistoryViolation(
+                        f"key {key}: final list {final} shorter than observed "
+                        f"{h.order}")
+            present.update(final)
+        missing = self._acked - present
+        if missing:
+            raise HistoryViolation(f"ack'd writes missing from final state: {missing}")

@@ -1,0 +1,6 @@
+from accord_tpu.topology.shard import Shard
+from accord_tpu.topology.topology import Topology
+from accord_tpu.topology.topologies import Topologies
+from accord_tpu.topology.manager import TopologyManager
+
+__all__ = ["Shard", "Topology", "Topologies", "TopologyManager"]

@@ -1,0 +1,125 @@
+"""Node-local epoch registry (reference: topology/TopologyManager.java:71).
+
+Tracks every known epoch's Topology plus per-epoch sync state (which nodes
+have acknowledged the epoch), and computes which Topologies a coordination
+must contact: all epochs in [txn_id.epoch, execute_at.epoch], extended
+backwards while older epochs are not yet fully synced (withUnsyncedEpochs).
+
+Round-1 scope: epochs are append-only and sync tracking is quorum-of-acks;
+range add/remove bookkeeping (addedRanges/removedRanges, closed/complete)
+arrives with the topology-change milestone.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from accord_tpu.primitives.routes import Route
+from accord_tpu.primitives.timestamp import NodeId
+from accord_tpu.topology.topologies import Topologies
+from accord_tpu.topology.topology import Topology
+from accord_tpu.utils.async_ import AsyncResult
+from accord_tpu.utils.invariants import Invariants
+
+
+class _EpochState:
+    __slots__ = ("topology", "sync_acks", "synced", "ready")
+
+    def __init__(self, topology: Topology):
+        self.topology = topology
+        self.sync_acks: set = set()
+        self.synced = False
+        self.ready: AsyncResult = AsyncResult()
+
+
+class TopologyManager:
+    def __init__(self, node_id: NodeId):
+        self.node_id = node_id
+        self._epochs: Dict[int, _EpochState] = {}
+        self._current_epoch = 0
+        self._awaiting: Dict[int, AsyncResult] = {}
+
+    # -- updates -------------------------------------------------------------
+    def on_topology_update(self, topology: Topology) -> None:
+        e = topology.epoch
+        if e in self._epochs:
+            return
+        Invariants.check_argument(e == self._current_epoch + 1 or self._current_epoch == 0,
+                                  "epoch gap: have %s, got %s", self._current_epoch, e)
+        st = _EpochState(topology)
+        self._epochs[e] = st
+        self._current_epoch = max(self._current_epoch, e)
+        # epoch 1 (or a single-node cluster) needs no sync from anyone else
+        if e == 1:
+            st.synced = True
+            st.ready.try_set_success(None)
+        waiter = self._awaiting.pop(e, None)
+        if waiter is not None:
+            waiter.try_set_success(topology)
+
+    def on_epoch_sync_complete(self, node: NodeId, epoch: int) -> None:
+        """A node reports it has fully synced (applied all prior-epoch state
+        relevant to) this epoch."""
+        st = self._epochs.get(epoch)
+        if st is None or st.synced:
+            return
+        st.sync_acks.add(node)
+        # quorum of every shard in the PRIOR epoch must ack before the new
+        # epoch is considered synced (reference: EpochState.syncTracker)
+        prev = self._epochs.get(epoch - 1)
+        basis = prev.topology if prev is not None else st.topology
+        if all(len(st.sync_acks & set(s.nodes)) >= s.slow_path_quorum_size
+               for s in basis.shards):
+            st.synced = True
+            st.ready.try_set_success(None)
+
+    # -- queries -------------------------------------------------------------
+    @property
+    def epoch(self) -> int:
+        return self._current_epoch
+
+    def current(self) -> Topology:
+        Invariants.check_state(self._current_epoch > 0, "no topology yet")
+        return self._epochs[self._current_epoch].topology
+
+    def for_epoch(self, epoch: int) -> Topology:
+        st = self._epochs.get(epoch)
+        Invariants.check_state(st is not None, "unknown epoch %s", epoch)
+        return st.topology
+
+    def has_epoch(self, epoch: int) -> bool:
+        return epoch in self._epochs
+
+    def min_epoch(self) -> int:
+        return min(self._epochs) if self._epochs else 0
+
+    def await_epoch(self, epoch: int) -> AsyncResult:
+        """Completes once the topology for `epoch` is known locally."""
+        if epoch in self._epochs:
+            from accord_tpu.utils.async_ import success
+            return success(self._epochs[epoch].topology)
+        return self._awaiting.setdefault(epoch, AsyncResult())
+
+    def epoch_ready(self, epoch: int) -> AsyncResult:
+        st = self._epochs.get(epoch)
+        Invariants.check_state(st is not None, "unknown epoch %s", epoch)
+        return st.ready
+
+    def is_synced(self, epoch: int) -> bool:
+        st = self._epochs.get(epoch)
+        return st is not None and st.synced
+
+    # -- the coordination contact-set computations ---------------------------
+    def precise_epochs(self, min_epoch: int, max_epoch: int) -> Topologies:
+        """Topologies for exactly [min_epoch, max_epoch], newest first."""
+        tops = [self._epochs[e].topology for e in range(max_epoch, min_epoch - 1, -1)]
+        return Topologies(tops)
+
+    def with_unsynced_epochs(self, route: Route, min_epoch: int, max_epoch: int) -> Topologies:
+        """Epochs [min', max_epoch] where min' extends below min_epoch while
+        epochs remain unsynced (so coordinations keep contacting the old
+        replica sets until handover quorums complete)."""
+        lo = min_epoch
+        floor = self.min_epoch()
+        while lo > floor and not self.is_synced(lo):
+            lo -= 1
+        return self.precise_epochs(lo, max_epoch)

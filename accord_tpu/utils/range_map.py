@@ -115,28 +115,20 @@ def merge(a: ReducingRangeMap, b: ReducingRangeMap, reduce: Callable) -> Reducin
 
 
 def _normalize(bounds: List[Any], values: List[Any]) -> ReducingRangeMap:
+    """Drop leading/trailing None segments and merge equal neighbours."""
     nb: List[Any] = []
     nv: List[Any] = []
     for i, v in enumerate(values):
+        if not nv and v is None:
+            continue  # leading None
         if nv and nv[-1] == v:
             continue  # extend previous segment; skip boundary
-        # close previous segment at bounds[i] implicitly by starting new one
-        if nv or v is not None:
-            if not nb:
-                if v is None:
-                    continue
-                nb.append(bounds[i])
-                nv.append(v)
-            else:
-                nb.append(bounds[i])
-                nv.append(v)
-        # else: still leading Nones, skip
+        nb.append(bounds[i])
+        nv.append(v)
     if not nv:
         return ReducingRangeMap.EMPTY
-    # find the end bound: last segment with non-None value
     last_non_none = max(i for i, v in enumerate(values) if v is not None)
     nb.append(bounds[last_non_none + 1])
-    # strip trailing None value segments from nv/nb
     while nv and nv[-1] is None:
         nv.pop()
         nb.pop(-2)

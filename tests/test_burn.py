@@ -1,0 +1,76 @@
+"""End-to-end simulated-cluster tests (the reference's burn-test strategy,
+SURVEY.md section 4.1, scaled down for CI)."""
+import pytest
+
+from accord_tpu.api import EventsListener
+from accord_tpu.sim.burn import run_burn
+from accord_tpu.sim.cluster import Cluster, ClusterConfig
+from accord_tpu.primitives.keyspace import Keys
+from accord_tpu.primitives.timestamp import TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.sim.list_store import ListQuery, ListRead, ListUpdate
+
+
+def test_burn_small():
+    r = run_burn(seed=42, ops=100)
+    assert r.acked == 100 and r.failed == 0 and r.lost == 0
+
+
+def test_burn_five_nodes():
+    r = run_burn(seed=7, ops=100, nodes=5, rf=3)
+    assert r.acked == 100 and r.failed == 0 and r.lost == 0
+
+
+def test_burn_single_hot_key_contention():
+    # maximum contention: all txns hit one key -> exercises the slow path
+    r = run_burn(seed=3, ops=80, key_count=1, concurrency=12)
+    assert r.acked == 80 and r.failed == 0 and r.lost == 0
+
+
+def test_burn_determinism():
+    a = run_burn(seed=99, ops=60, collect_log=True)
+    b = run_burn(seed=99, ops=60, collect_log=True)
+    assert a.log == b.log and len(a.log) == 60
+
+
+class _PathCounter(EventsListener):
+    def __init__(self):
+        self.fast = 0
+        self.slow = 0
+
+    def on_fast_path_taken(self, txn_id):
+        self.fast += 1
+
+    def on_slow_path_taken(self, txn_id):
+        self.slow += 1
+
+
+def _run_counted(seed, n_txns, same_key: bool):
+    cluster = Cluster(seed, ClusterConfig())
+    counter = _PathCounter()
+    for node in cluster.nodes.values():
+        node.events = counter
+    results = []
+    for i in range(n_txns):
+        key = 5 if same_key else 100 + i * 50
+        txn = Txn(TxnKind.WRITE, Keys.of(key), read=ListRead(Keys.of(key)),
+                  update=ListUpdate(Keys.of(key), i + 1), query=ListQuery())
+        node = cluster.nodes[1 + i % len(cluster.nodes)]
+        cluster.queue.add(i * 100, lambda n=node, t=txn: results.append(n.coordinate(t)))
+    cluster.drain()
+    cluster.check_no_failures()
+    assert all(r.success for r in results)
+    return counter
+
+
+def test_uncontended_takes_fast_path():
+    c = _run_counted(1, 10, same_key=False)
+    assert c.fast == 10 and c.slow == 0
+
+
+def test_contended_exercises_slow_path():
+    # near-simultaneous same-key txns from different coordinators cannot all
+    # witness themselves first -> some must take the slow path
+    c = _run_counted(2, 10, same_key=True)
+    assert c.fast + c.slow == 10
+    assert c.slow > 0

@@ -43,10 +43,10 @@ def test_point_ranges():
     pr = k.to_ranges()
     assert pr.contains_key(1) and pr.contains_key(5)
     assert not pr.contains_key(2)
-    # successor bound: point range of 1 must not contain any key > 1
-    assert not pr.contains_key(1.0000001) or True  # float keys not used; int domain:
+    # successor bound: point range of k contains exactly k
     assert Range.point(1).contains(1)
     assert not Range.point(1).contains(2)
+    assert not Range.point(1).contains(0)
 
 
 def test_randomized_ranges_vs_naive():

@@ -1,0 +1,152 @@
+"""Unit tests of the local engine's transitions and execution scheduling,
+driven directly against a CommandStore (reference: local/CommandsTest.java)."""
+from accord_tpu.local import commands
+from accord_tpu.local.commands import AcceptOutcome, CommitOutcome
+from accord_tpu.local.status import Status
+from accord_tpu.primitives.deps import Deps, KeyDeps
+from accord_tpu.primitives.keyspace import Keys
+from accord_tpu.primitives.timestamp import Ballot, Timestamp, TxnId, TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.sim.cluster import Cluster, ClusterConfig
+from accord_tpu.sim.list_store import ListQuery, ListRead, ListUpdate, ListWrite
+from accord_tpu.primitives.writes import Writes
+
+
+def setup_store():
+    cluster = Cluster(1, ClusterConfig(num_nodes=1, rf=1, num_shards=1,
+                                       stores_per_node=1))
+    node = cluster.nodes[1]
+    return cluster, node, node.command_stores.stores[0]
+
+
+def mk_txn(keys, value=None):
+    k = Keys(keys)
+    upd = ListUpdate(k, value) if value is not None else None
+    kind = TxnKind.WRITE if value is not None else TxnKind.READ
+    return Txn(kind, k, read=ListRead(k), update=upd, query=ListQuery())
+
+
+def preaccepted(node, store, keys, value=1):
+    txn = mk_txn(keys, value)
+    txn_id = node.next_txn_id(txn.kind, txn.domain)
+    route = node.compute_route(txn)
+    partial = txn.slice(store.ranges, include_query=False)
+    out = commands.preaccept(store, txn_id, partial, route)
+    assert out == AcceptOutcome.SUCCESS
+    return txn_id, txn, route
+
+
+def test_preaccept_fast_path_vote():
+    _, node, store = setup_store()
+    txn_id, txn, _ = preaccepted(node, store, [5])
+    cmd = store.command(txn_id)
+    assert cmd.status == Status.PRE_ACCEPTED
+    assert cmd.execute_at == txn_id  # uncontended: witnessed at txnId
+
+
+def test_preaccept_contended_witnesses_later():
+    _, node, store = setup_store()
+    t1, txn1, _ = preaccepted(node, store, [5])
+    # a txn with an OLDER id arriving after t1 was witnessed cannot fast-path
+    old_id = TxnId.create(t1.epoch, t1.hlc - 5, 99, TxnKind.WRITE)
+    txn2 = mk_txn([5], 2)
+    out = commands.preaccept(store, old_id, txn2.slice(store.ranges, False),
+                             node.compute_route(txn2))
+    assert out == AcceptOutcome.SUCCESS
+    cmd = store.command(old_id)
+    assert cmd.execute_at > old_id  # witnessed later than its id
+
+
+def test_preaccept_ballot_rejection():
+    _, node, store = setup_store()
+    txn_id, txn, route = preaccepted(node, store, [5])
+    cmd = store.command(txn_id)
+    cmd.promised = Ballot(1, 100, 0, 2)  # a recovery coordinator promised
+    out = commands.preaccept(store, txn_id, txn.slice(store.ranges, False), route)
+    assert out == AcceptOutcome.REJECTED_BALLOT
+
+
+def test_deps_calculation_orders_by_txn_id():
+    _, node, store = setup_store()
+    t1, _, _ = preaccepted(node, store, [5], 1)
+    t2, _, _ = preaccepted(node, store, [5], 2)
+    deps2 = store.calculate_deps(t2, Keys.of(5), t2)
+    assert deps2.for_key(5) == (t1,)
+    deps1 = store.calculate_deps(t1, Keys.of(5), t1)
+    assert deps1.for_key(5) == ()  # t2 started after t1
+
+
+def test_read_does_not_witness_read():
+    _, node, store = setup_store()
+    r1, _, _ = preaccepted(node, store, [5], None)  # read txn
+    r2, _, _ = preaccepted(node, store, [5], None)
+    w3, _, _ = preaccepted(node, store, [5], 3)
+    assert store.calculate_deps(r2, Keys.of(5), r2).for_key(5) == ()
+    # the write witnesses both reads
+    assert store.calculate_deps(w3, Keys.of(5), w3).for_key(5) == (r1, r2)
+
+
+def test_execution_waits_for_deps():
+    cluster, node, store = setup_store()
+    t1, txn1, route1 = preaccepted(node, store, [5], 1)
+    t2, txn2, route2 = preaccepted(node, store, [5], 2)
+    deps2 = Deps(KeyDeps.of({5: [t1]}))
+    # commit t2 (with dep on t1) before t1 commits
+    commands.commit(store, t2, route2, txn2.slice(store.ranges, False),
+                    t2.as_timestamp(), deps2)
+    cmd2 = store.command(t2)
+    assert cmd2.status == Status.STABLE
+    assert t1 in cmd2.waiting_on.commit
+    # commit t1 -> t2 now waits for apply
+    commands.commit(store, t1, route1, txn1.slice(store.ranges, False),
+                    t1.as_timestamp(), Deps.NONE)
+    assert store.command(t1).status == Status.READY_TO_EXECUTE  # no deps
+    assert t1 in cmd2.waiting_on.apply and not cmd2.waiting_on.commit
+    # apply t1 -> t2 becomes ready
+    w1 = Writes(t1, t1.as_timestamp(), Keys.of(5), ListWrite({5: 1}))
+    commands.apply(store, t1, route1, txn1.slice(store.ranges, False),
+                   t1.as_timestamp(), Deps.NONE, w1, None)
+    assert store.command(t1).status == Status.APPLIED
+    assert cmd2.status == Status.READY_TO_EXECUTE
+    assert node.data_store.snapshot(5) == (1,)
+
+
+def test_dep_executing_after_is_not_waited_on():
+    cluster, node, store = setup_store()
+    t1, txn1, route1 = preaccepted(node, store, [5], 1)
+    t2, txn2, route2 = preaccepted(node, store, [5], 2)
+    # t1 commits with executeAt AFTER t2 (slow path pushed it past t2)
+    late = Timestamp(t2.epoch, t2.hlc + 100, 0, 1)
+    commands.commit(store, t1, route1, txn1.slice(store.ranges, False),
+                    late, Deps.NONE)
+    # t2 depends on t1 but t1 executes after t2 -> no wait
+    commands.commit(store, t2, route2, txn2.slice(store.ranges, False),
+                    t2.as_timestamp(), Deps(KeyDeps.of({5: [t1]})))
+    cmd2 = store.command(t2)
+    assert cmd2.status == Status.READY_TO_EXECUTE
+
+
+def test_invalidated_dep_is_dropped():
+    cluster, node, store = setup_store()
+    t1, txn1, route1 = preaccepted(node, store, [5], 1)
+    t2, txn2, route2 = preaccepted(node, store, [5], 2)
+    commands.commit(store, t2, route2, txn2.slice(store.ranges, False),
+                    t2.as_timestamp(), Deps(KeyDeps.of({5: [t1]})))
+    cmd2 = store.command(t2)
+    assert t1 in cmd2.waiting_on.commit
+    commands.commit_invalidate(store, t1)
+    assert cmd2.status == Status.READY_TO_EXECUTE
+
+
+def test_accept_updates_execute_at():
+    _, node, store = setup_store()
+    t1, txn1, route1 = preaccepted(node, store, [5], 1)
+    ea = Timestamp(t1.epoch, t1.hlc + 50, 0, 2)
+    out = commands.accept(store, t1, Ballot.ZERO, route1, Keys.of(5), ea)
+    assert out == AcceptOutcome.SUCCESS
+    cmd = store.command(t1)
+    assert cmd.status == Status.ACCEPTED and cmd.execute_at == ea
+    # later preaccept of a new txn must witness a timestamp above ea
+    t2, _, _ = preaccepted(node, store, [5], 2)
+    cmd2 = store.command(t2)
+    assert cmd2.execute_at == t2 or cmd2.execute_at > ea

@@ -40,14 +40,20 @@ def test_txnid_kind_domain():
 
 
 def test_witness_rules():
-    R, W = TxnKind.READ, TxnKind.WRITE
+    # exact mirror of reference Txn.Kind.witnesses (primitives/Txn.java:224)
+    R, W, ER = TxnKind.READ, TxnKind.WRITE, TxnKind.EPHEMERAL_READ
     SP, XSP = TxnKind.SYNC_POINT, TxnKind.EXCLUSIVE_SYNC_POINT
     assert R.witnesses(W) and not R.witnesses(R)
+    assert not R.witnesses(XSP) and not R.witnesses(SP)
+    assert ER.witnesses(W) and not ER.witnesses(R)
     assert W.witnesses(R) and W.witnesses(W)
-    assert SP.witnesses(R) and SP.witnesses(W)
-    assert XSP.witnesses(W)
-    assert not R.witnesses(SP)
+    assert not W.witnesses(SP) and not W.witnesses(XSP) and not W.witnesses(ER)
+    assert SP.witnesses(R) and SP.witnesses(W) and not SP.witnesses(SP)
+    assert XSP.witnesses(R) and XSP.witnesses(W)
+    assert XSP.witnesses(SP) and XSP.witnesses(XSP)  # AnyGloballyVisible
+    assert not XSP.witnesses(ER)
     assert W.witnessed_by(R)
+    assert not ER.witnessed_by(W)  # nothing witnesses ephemeral reads
 
 
 def test_ballot():

@@ -1,0 +1,117 @@
+from accord_tpu.coordinate.tracking import (
+    FastPathTracker, QuorumTracker, ReadTracker, RequestStatus,
+)
+from accord_tpu.primitives.keyspace import Range
+from accord_tpu.topology.shard import Shard
+from accord_tpu.topology.topologies import Topologies
+from accord_tpu.topology.topology import Topology
+
+
+def topo3():
+    return Topologies.single(Topology(1, [Shard(Range(0, 100), [1, 2, 3])]))
+
+
+def topo5_2shards():
+    return Topologies.single(Topology(1, [
+        Shard(Range(0, 50), [1, 2, 3]),
+        Shard(Range(50, 100), [3, 4, 5]),
+    ]))
+
+
+def test_shard_quorum_math():
+    s = Shard(Range(0, 1), [1, 2, 3])
+    assert s.max_failures == 1
+    assert s.slow_path_quorum_size == 2
+    assert s.fast_path_quorum_size == 3  # (1 + 3)//2 + 1
+    s5 = Shard(Range(0, 1), [1, 2, 3, 4, 5])
+    assert s5.max_failures == 2
+    assert s5.slow_path_quorum_size == 3
+    assert s5.fast_path_quorum_size == 4  # (2 + 5)//2 + 1
+    assert not s5.rejects_fast_path(1)
+    assert s5.rejects_fast_path(2)
+
+
+def test_quorum_tracker():
+    t = QuorumTracker(topo3())
+    assert t.nodes() == (1, 2, 3)
+    assert t.on_success(1) == RequestStatus.NO_CHANGE
+    assert t.on_success(2) == RequestStatus.SUCCESS
+    assert t.on_success(3) == RequestStatus.NO_CHANGE  # already decided
+
+
+def test_quorum_tracker_failure():
+    t = QuorumTracker(topo3())
+    assert t.on_failure(1) == RequestStatus.NO_CHANGE
+    assert t.on_failure(2) == RequestStatus.FAILED
+
+
+def test_quorum_tracker_multi_shard():
+    t = QuorumTracker(topo5_2shards())
+    # quorum in shard 1 only
+    t.on_success(1)
+    assert t.on_success(2) == RequestStatus.NO_CHANGE
+    # node 3 counts for both shards; shard 2 needs one more
+    t.on_success(3)
+    assert t.on_success(4) == RequestStatus.SUCCESS
+
+
+def test_fast_path_tracker_fast():
+    t = FastPathTracker(topo3())
+    assert t.on_success(1, True) == RequestStatus.NO_CHANGE
+    assert t.on_success(2, True) == RequestStatus.NO_CHANGE
+    # rf=3: fast quorum is all 3 electorate members
+    assert t.on_success(3, True) == RequestStatus.SUCCESS
+    assert t.has_fast_path_accepted()
+
+
+def test_fast_path_tracker_slow_resolution():
+    t = FastPathTracker(topo3())
+    t.on_success(1, True)
+    t.on_success(2, True)
+    # a single non-fast vote makes fq=3 impossible -> resolve slow
+    assert t.on_success(3, False) == RequestStatus.SUCCESS
+    assert not t.has_fast_path_accepted()
+
+
+def test_fast_path_tracker_waits_for_resolution():
+    t = FastPathTracker(topo3())
+    # quorum reached but fast path still possible: must NOT decide yet
+    assert t.on_success(1, True) == RequestStatus.NO_CHANGE
+    assert t.on_success(2, True) == RequestStatus.NO_CHANGE
+    assert t.decided is None
+
+
+def test_fast_path_tracker_electorate_failure():
+    t = FastPathTracker(topo3())
+    t.on_success(1, True)
+    t.on_success(2, True)
+    # failure of the third electorate member rules out fq=3
+    assert t.on_failure(3) == RequestStatus.SUCCESS
+    assert not t.has_fast_path_accepted()
+
+
+def test_read_tracker():
+    t = ReadTracker(topo5_2shards())
+    contacts = t.initial_contacts()
+    # node 3 replicates both shards -> a single contact may cover both
+    assert len(contacts) in (1, 2)
+    for c in contacts:
+        st = t.on_data_success(c)
+    assert t.decided == RequestStatus.SUCCESS
+
+
+def test_read_tracker_escalation():
+    t = ReadTracker(topo3())
+    (c,) = t.initial_contacts()
+    status, more = t.on_read_failure(c)
+    assert status == RequestStatus.NO_CHANGE and len(more) == 1
+    assert t.on_data_success(more[0]) == RequestStatus.SUCCESS
+
+
+def test_read_tracker_exhaustion():
+    t = ReadTracker(topo3())
+    (c1,) = t.initial_contacts()
+    _, (c2,) = t.on_read_failure(c1)
+    _, (c3,) = t.on_read_failure(c2)
+    status, more = t.on_read_failure(c3)
+    assert status == RequestStatus.FAILED

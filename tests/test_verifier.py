@@ -1,0 +1,76 @@
+"""The verifier must catch injected violations -- otherwise burn green means
+nothing (the reference validates its checkers the same way)."""
+import pytest
+
+from accord_tpu.sim.verifier import HistoryViolation, StrictSerializabilityVerifier
+
+
+def mk():
+    v = StrictSerializabilityVerifier()
+    for val, t in [(1, 10), (2, 20), (3, 30)]:
+        v.on_issue_write(val, t)
+    return v
+
+
+def test_accepts_consistent_history():
+    v = mk()
+    v.witness(10, 15, {"k": ()}, {"k": 1})
+    v.witness(20, 25, {"k": (1,)}, {"k": 2})
+    v.witness(30, 35, {"k": (1, 2)}, {"k": 3})
+    v.check_final_state({"k": (1, 2, 3)})
+
+
+def test_rejects_divergent_order():
+    v = mk()
+    v.witness(40, 45, {"k": (1, 2)}, {})
+    with pytest.raises(HistoryViolation, match="divergent"):
+        v.witness(42, 55, {"k": (2, 1)}, {})
+
+
+def test_rejects_own_write_observed():
+    v = mk()
+    with pytest.raises(HistoryViolation, match="own write"):
+        v.witness(10, 15, {"k": (1,)}, {"k": 1})
+
+
+def test_rejects_unknown_value():
+    v = mk()
+    with pytest.raises(HistoryViolation, match="unknown value"):
+        v.witness(10, 15, {"k": (99,)}, {})
+
+
+def test_rejects_stale_read_after_completed_read():
+    v = mk()
+    # txn A completed at 45 having observed (1, 2)
+    v.witness(40, 45, {"k": (1, 2)}, {})
+    # txn B started at 50 (> 45) but observed less -> real-time violation
+    with pytest.raises(HistoryViolation, match="missing writes"):
+        v.witness(50, 55, {"k": (1,)}, {})
+
+
+def test_rejects_invisible_acked_write():
+    v = mk()
+    v.witness(10, 15, {}, {"k": 1})  # ack'd write of 1 completed at 15
+    with pytest.raises(HistoryViolation, match="not visible"):
+        v.witness(20, 25, {"k": ()}, {})
+
+
+def test_concurrent_reads_may_be_stale():
+    v = mk()
+    # overlapping txns: B started before A completed -> no real-time edge
+    v.witness(40, 60, {"k": (1, 2)}, {})
+    v.witness(50, 70, {"k": (1,)}, {})  # fine: started at 50 < 60
+
+
+def test_rejects_lost_acked_write():
+    v = mk()
+    v.witness(10, 15, {}, {"k": 2})
+    with pytest.raises(HistoryViolation, match="missing from final state"):
+        v.check_final_state({"k": (1, 3)})
+
+
+def test_rejects_final_divergence():
+    v = mk()
+    v.witness(40, 45, {"k": (1, 2)}, {})
+    with pytest.raises(HistoryViolation, match="diverges|shorter"):
+        v.check_final_state({"k": (2, 1)})
