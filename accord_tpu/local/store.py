@@ -41,6 +41,9 @@ class CommandStore:
         self.commands: Dict[TxnId, Command] = {}
         self.cfks: Dict[Key, CommandsForKey] = {}
         self.range_txns: Dict[TxnId, Ranges] = {}  # witnessed range-domain txns
+        # max witnessed conflict per exact key (hot path: O(1) updates);
+        # range-domain txns land in the range map (rare, merged on query)
+        self.max_conflicts_by_key: Dict[Key, Timestamp] = {}
         self.max_conflicts: ReducingRangeMap = ReducingRangeMap.EMPTY
         self.progress_log = (progress_log_factory(self) if progress_log_factory
                              else _NoopProgressLog())
@@ -92,19 +95,24 @@ class CommandStore:
         out: Optional[Timestamp] = None
         if isinstance(seekables, Keys):
             for k in seekables:
-                v = self.max_conflicts.get(k)
-                out = Timestamp.merge_max(out, v)
+                out = Timestamp.merge_max(out, self.max_conflicts_by_key.get(k))
+                out = Timestamp.merge_max(out, self.max_conflicts.get(k))
         else:
             for r in seekables:
                 out = self.max_conflicts.fold_over_range(
                     r.start, r.end, Timestamp.merge_max, out)
+            for k, v in self.max_conflicts_by_key.items():
+                if seekables.contains_key(k):
+                    out = Timestamp.merge_max(out, v)
         return out
 
     def update_max_conflicts(self, seekables: Seekables, ts: Timestamp) -> None:
         if isinstance(seekables, Keys):
+            by_key = self.max_conflicts_by_key
             for k in seekables:
-                self.max_conflicts = self.max_conflicts.with_range(
-                    k, _key_successor(k), ts, Timestamp.merge_max)
+                prev = by_key.get(k)
+                if prev is None or ts > prev:
+                    by_key[k] = ts
         else:
             for r in seekables:
                 self.max_conflicts = self.max_conflicts.with_range(
@@ -183,9 +191,3 @@ class CommandStore:
 class _NoopProgressLog:
     def __getattr__(self, name):
         return lambda *a, **k: None
-
-
-def _key_successor(k):
-    """End bound of a single-key interval in the max-conflicts map."""
-    from accord_tpu.primitives.keyspace import _Successor
-    return _Successor(k)

@@ -1,0 +1,81 @@
+"""Host<->device encoding for protocol values.
+
+Timestamps: the protocol orders by (epoch, hlc, flags, node). TPUs prefer
+int32 lanes (int64 is emulated), so the device encoding is three int32 lanes
+relative to a per-batch base, compared lexicographically:
+
+  lane0 = epoch - base_epoch          (small non-negative int)
+  lane1 = hlc - base_hlc              (window-checked: |delta| < 2^31 us)
+  lane2 = flags << 16 | node
+
+The hlc window (~35 minutes of microseconds) vastly exceeds any active-set
+span; the encoder verifies membership and the resolver asserts rather than
+silently dropping out-of-window entries.
+
+Keys: the burn test's hash-key domain maps keys directly to bitmap columns
+via key % K buckets. Bucketing makes the bitmap a *conservative overestimate*
+(two keys may share a column), which is safe for deps (extra deps are merely
+redundant edges, and the host CSR conversion re-filters per real key).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from accord_tpu.primitives.timestamp import Timestamp, TxnId, TxnKind
+
+# WITNESS_TABLE[a, b] == 1 iff kind a witnesses kind b (mirrors
+# primitives.timestamp._WITNESSES, itself a mirror of reference
+# Txn.Kind.witnesses primitives/Txn.java:224).
+WITNESS_TABLE = np.zeros((6, 6), dtype=np.int32)
+for _a in TxnKind:
+    for _b in TxnKind:
+        WITNESS_TABLE[int(_a), int(_b)] = 1 if _a.witnesses(_b) else 0
+
+_WINDOW = (1 << 31) - 1
+
+
+class TimestampEncoder:
+    """Encodes a batch of timestamps as int32 (lane0, lane1) pairs with a
+    shared (epoch, hlc) base."""
+
+    def __init__(self, base_epoch: int, base_hlc: int):
+        self.base_epoch = base_epoch
+        self.base_hlc = base_hlc
+
+    @classmethod
+    def for_timestamps(cls, tss: Sequence[Timestamp]) -> "TimestampEncoder":
+        if not tss:
+            return cls(0, 0)
+        lo = min(tss)
+        return cls(lo.epoch, lo.hlc)
+
+    def in_window(self, ts: Timestamp) -> bool:
+        return (0 <= ts.epoch - self.base_epoch < _WINDOW
+                and -_WINDOW < ts.hlc - self.base_hlc < _WINDOW)
+
+    def encode(self, tss: Sequence[Timestamp]) -> np.ndarray:
+        """-> int32[len(tss), 3]; raises if any timestamp out of window."""
+        out = np.empty((len(tss), 3), dtype=np.int32)
+        for i, ts in enumerate(tss):
+            if not self.in_window(ts):
+                raise ValueError(f"timestamp {ts} outside encoder window")
+            out[i, 0] = ts.epoch - self.base_epoch
+            out[i, 1] = ts.hlc - self.base_hlc
+            out[i, 2] = (ts.flags << 16) | ts.node
+        return out
+
+
+def encode_key_bitmaps(key_sets: Sequence[Sequence[int]], num_buckets: int) -> np.ndarray:
+    """-> float bitmap [len(key_sets), num_buckets] with 1.0 where the txn
+    touches a key hashing to that bucket (float for MXU matmul)."""
+    out = np.zeros((len(key_sets), num_buckets), dtype=np.float32)
+    for i, keys in enumerate(key_sets):
+        for k in keys:
+            out[i, int(k) % num_buckets] = 1.0
+    return out
+
+
+def encode_kinds(txn_ids: Sequence[TxnId]) -> np.ndarray:
+    return np.array([int(t.kind) for t in txn_ids], dtype=np.int32)

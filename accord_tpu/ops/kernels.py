@@ -1,0 +1,137 @@
+"""JAX kernels for the deps data plane.
+
+Design notes (TPU-first):
+  - The conflict test is a boolean matmul: bitmap[B,K] @ bitmap[A,K]^T on the
+    MXU in bfloat16 with float32 accumulation. K (key buckets) is a multiple
+    of 128 (lane width); B and A are padded to multiples of 8 (sublanes).
+  - Kind filtering is a gather from the 6x6 witness table; timestamp
+    comparison is lexicographic over two int32 lanes -- both VPU element-wise
+    ops XLA fuses into the matmul epilogue.
+  - Transitive closure is iterated boolean matmul (repeated squaring), the
+    standard reachability-by-matmul formulation; log2(N) MXU rounds.
+All functions are jit-compiled with static shapes; callers pad to bucket
+sizes (see resolver.py) so compilation caches are hit.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _lex_before(a, b):
+    """a < b lexicographically over 3 int32 lanes; a: [..., 3], b: [..., 3]
+    (broadcasting)."""
+    return ((a[..., 0] < b[..., 0])
+            | ((a[..., 0] == b[..., 0])
+               & ((a[..., 1] < b[..., 1])
+                  | ((a[..., 1] == b[..., 1]) & (a[..., 2] < b[..., 2])))))
+
+
+@functools.partial(jax.jit, static_argnames=())
+def deps_matrix(subj_bitmaps, subj_before, subj_kinds,
+                act_bitmaps, act_ts, act_kinds, act_valid,
+                witness_table):
+    """Pairwise dependency matrix.
+
+    subj_bitmaps: f32[B, K]   keys touched by each subject txn
+    subj_before:  i32[B, 3]   'started before' bound per subject (usually the
+                              witnessed executeAt; reference semantics of
+                              mapReduceActive STARTED_BEFORE)
+    subj_kinds:   i32[B]
+    act_bitmaps:  f32[A, K]   active-set key bitmaps
+    act_ts:       i32[A, 3]   active txn ids (3-lane window-relative encoding)
+    act_kinds:    i32[A]
+    act_valid:    bool[A]     false for padding / invalidated entries
+    witness_table: i32[6, 6]
+
+    -> bool[B, A] : dep[b, a] == True iff active txn a is a dependency of
+                    subject b (keys overlap AND subject witnesses a's kind AND
+                    a started before b's bound AND a != b).
+    """
+    overlap = jax.lax.dot_general(
+        subj_bitmaps.astype(jnp.bfloat16), act_bitmaps.astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) > 0.5
+    witness = witness_table[subj_kinds[:, None], act_kinds[None, :]] == 1
+    before = _lex_before(act_ts[None, :, :], subj_before[:, None, :])
+    return overlap & witness & before & act_valid[None, :]
+
+
+@functools.partial(jax.jit, static_argnames=())
+def max_conflict(subj_bitmaps, subj_kinds, act_bitmaps, act_exec_ts,
+                 act_kinds, act_valid, witness_table):
+    """Max witnessed-conflict timestamp per subject (feeds the fast-path test
+    txnId >= maxConflicts; reference: MaxConflicts + CommandStore.preaccept).
+
+    act_exec_ts: i32[A, 3] -- max(executeAt, txnId) per active txn.
+    -> i32[B, 3] lexicographic max over conflicting actives (INT32_MIN lanes
+       where no conflict).
+    """
+    overlap = jax.lax.dot_general(
+        subj_bitmaps.astype(jnp.bfloat16), act_bitmaps.astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) > 0.5
+    conflicts = witness_table[subj_kinds[:, None], act_kinds[None, :]] == 1
+    conflicts |= witness_table[act_kinds[None, :], subj_kinds[:, None]] == 1
+    mask = overlap & conflicts & act_valid[None, :]
+    neg = jnp.int32(np.iinfo(np.int32).min)
+    # lexicographic max without int64: successive tie-narrowing per lane
+    l0 = jnp.where(mask, act_exec_ts[None, :, 0], neg)
+    m0 = jnp.max(l0, axis=1)
+    tie0 = mask & (act_exec_ts[None, :, 0] == m0[:, None])
+    l1 = jnp.where(tie0, act_exec_ts[None, :, 1], neg)
+    m1 = jnp.max(l1, axis=1)
+    tie1 = tie0 & (act_exec_ts[None, :, 1] == m1[:, None])
+    l2 = jnp.where(tie1, act_exec_ts[None, :, 2], neg)
+    m2 = jnp.max(l2, axis=1)
+    return jnp.stack([m0, m1, m2], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("iterations",))
+def transitive_closure(adj, iterations: int):
+    """Reachability closure of a boolean adjacency matrix by repeated
+    squaring: R_{i+1} = R_i | (R_i @ R_i). `iterations` >= ceil(log2(N)).
+    (the execute-order closure kernel; BASELINE config 'Synthetic Execute
+    DAG')."""
+
+    def body(_, r):
+        rf = r.astype(jnp.bfloat16)
+        sq = jax.lax.dot_general(rf, rf, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32) > 0.5
+        return r | sq
+
+    return jax.lax.fori_loop(0, iterations, body, adj)
+
+
+@functools.partial(jax.jit, static_argnames=("max_levels",))
+def execution_wavefronts(adj, max_levels: int):
+    """Topological execution levels of a dependency DAG: level[i] = longest
+    dependency chain ending at i (the order the execution engine may release
+    txns in parallel waves). adj[i, j] == True iff i depends on j.
+    -> i32[N] levels (max_levels if a cycle prevents settling)."""
+    n = adj.shape[0]
+
+    def body(_, level):
+        # level'_i = 1 + max_j adj[i,j] * level_j   (0 if no deps)
+        dep_levels = jnp.where(adj, level[None, :] + 1, 0)
+        return jnp.maximum(level, jnp.max(dep_levels, axis=1))
+
+    return jax.lax.fori_loop(0, max_levels, body, jnp.zeros(n, jnp.int32))
+
+
+def pad_to(x: np.ndarray, size: int, axis: int = 0) -> np.ndarray:
+    """Pad axis up to `size` with zeros (bucketed static shapes for jit)."""
+    if x.shape[axis] == size:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, size - x.shape[axis])
+    return np.pad(x, pad)
+
+
+def bucket_size(n: int, minimum: int = 8) -> int:
+    """Next power-of-two bucket >= n (>= minimum), so jit caches stay warm."""
+    size = minimum
+    while size < n:
+        size *= 2
+    return size

@@ -1,0 +1,154 @@
+"""The DepsResolver SPI and its implementations.
+
+The reference computes deps per-request inside each CommandStore via
+hand-tuned scans (SafeCommandStore.mapReduceActive ->
+CommandsForKey.mapReduceActive, local/cfk/CommandsForKey.java:910). Here that
+query is an SPI:
+
+  HostDepsResolver  -- delegates to the store's Python scan (reference
+                       behaviour, used for differential testing)
+  BatchDepsResolver -- encodes the store's active set + a micro-batch of
+                       subjects as tensors and runs ops.kernels.deps_matrix
+                       on the device; exact per-key CSR is recovered on host
+                       by intersecting real key sets (bucket collisions are
+                       filtered, so the result equals the host scan).
+
+Batching model: the protocol's map-reduce hands us one subject at a time;
+the resolver accumulates the store's active set lazily and (re)encodes only
+when it changed (epoch counter), so a burst of PreAccepts against the same
+store state is one encode + N cheap device rows, and a true micro-batch API
+(resolve_batch) serves the bench/pipelined path.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from accord_tpu.local.cfk import CfkStatus
+from accord_tpu.ops.encoding import (
+    TimestampEncoder, WITNESS_TABLE, encode_key_bitmaps,
+)
+from accord_tpu.primitives.deps import Deps, KeyDepsBuilder
+from accord_tpu.primitives.keyspace import Keys, Seekables
+from accord_tpu.primitives.timestamp import Timestamp, TxnId
+
+
+class DepsResolver:
+    def resolve_one(self, store, txn_id: TxnId, seekables: Seekables,
+                    before: Timestamp) -> Deps:
+        raise NotImplementedError
+
+
+class HostDepsResolver(DepsResolver):
+    def resolve_one(self, store, txn_id, seekables, before) -> Deps:
+        return store.host_calculate_deps(txn_id, seekables, before)
+
+
+class _ActiveSet:
+    """Snapshot of a store's witnessed key-txns in tensor form."""
+
+    def __init__(self, txn_ids: List[TxnId], key_sets: List[tuple],
+                 encoder: TimestampEncoder, num_buckets: int):
+        import jax.numpy as jnp
+        self.txn_ids = txn_ids
+        self.key_sets = key_sets
+        self.encoder = encoder
+        n = max(1, len(txn_ids))
+        from accord_tpu.ops.kernels import bucket_size, pad_to
+        padded = bucket_size(n)
+        bitmaps = encode_key_bitmaps(key_sets, num_buckets)
+        ts = encoder.encode(txn_ids) if txn_ids else np.zeros((0, 3), np.int32)
+        kinds = np.array([int(t.kind) for t in txn_ids], dtype=np.int32)
+        valid = np.ones(len(txn_ids), dtype=bool)
+        self.bitmaps = jnp.asarray(pad_to(bitmaps, padded))
+        self.ts = jnp.asarray(pad_to(ts, padded))
+        self.kinds = jnp.asarray(pad_to(kinds, padded))
+        self.valid = jnp.asarray(pad_to(valid, padded))
+
+
+class BatchDepsResolver(DepsResolver):
+    def __init__(self, num_buckets: int = 256):
+        import jax.numpy as jnp
+        self.num_buckets = num_buckets
+        self._table = jnp.asarray(WITNESS_TABLE)
+        self._cache: Dict[int, Tuple[int, _ActiveSet]] = {}  # store id -> (version, set)
+        self._versions: Dict[int, int] = {}
+
+    # -- active-set maintenance ---------------------------------------------
+    def _store_version(self, store) -> int:
+        # cheap change detector: count of registered infos across cfks
+        return sum(len(c) for c in store.cfks.values()) + len(store.range_txns) * 1000003
+
+    def _active_set(self, store) -> _ActiveSet:
+        version = self._store_version(store)
+        cached = self._cache.get(id(store))
+        if cached is not None and cached[0] == version:
+            return cached[1]
+        by_txn: Dict[TxnId, set] = {}
+        tss: List[Timestamp] = []
+        for key, cfk in store.cfks.items():
+            for t, info in cfk._infos.items():
+                if info.status == CfkStatus.INVALIDATED:
+                    continue
+                by_txn.setdefault(t, set()).add(key)
+        txn_ids = sorted(by_txn)
+        encoder = TimestampEncoder.for_timestamps(txn_ids or [Timestamp.NONE])
+        in_window = [t for t in txn_ids if encoder.in_window(t)]
+        # stragglers outside the window would need host supplement; with
+        # window ~35min of hlc this is unreachable in practice (invariant
+        # checked so it cannot silently drop deps)
+        assert len(in_window) == len(txn_ids), "active txn outside encoder window"
+        aset = _ActiveSet(txn_ids, [tuple(sorted(by_txn[t])) for t in txn_ids],
+                          encoder, self.num_buckets)
+        self._cache[id(store)] = (version, aset)
+        return aset
+
+    # -- SPI ----------------------------------------------------------------
+    def resolve_one(self, store, txn_id, seekables, before) -> Deps:
+        if not isinstance(seekables, Keys):
+            # range-domain subjects stay on the host path for now
+            return store.host_calculate_deps(txn_id, seekables, before)
+        owned = store.owned(seekables)
+        rows = self.resolve_batch(store, [(txn_id, owned, before)])
+        deps = rows[0]
+        if store.range_txns:
+            # range txns are tracked host-side; union them in
+            host_range = store.host_calculate_deps(txn_id, owned, before)
+            deps = deps.union(host_range)
+        return deps
+
+    def resolve_batch(self, store,
+                      subjects: Sequence[Tuple[TxnId, Keys, Timestamp]]) -> List[Deps]:
+        """Resolve deps for a micro-batch of (txn_id, owned keys, before)."""
+        import jax.numpy as jnp
+        from accord_tpu.ops.kernels import bucket_size, deps_matrix, pad_to
+        aset = self._active_set(store)
+        if not aset.txn_ids:
+            return [Deps.NONE for _ in subjects]
+        b = len(subjects)
+        padded_b = bucket_size(b)
+        bitmaps = encode_key_bitmaps([tuple(kk) for _, kk, _ in subjects],
+                                     self.num_buckets)
+        before_ts = aset.encoder.encode([bound for _, _, bound in subjects])
+        kinds = np.array([int(t.kind) for t, _, _ in subjects], dtype=np.int32)
+        matrix = deps_matrix(
+            jnp.asarray(pad_to(bitmaps, padded_b)),
+            jnp.asarray(pad_to(before_ts, padded_b)),
+            jnp.asarray(pad_to(kinds, padded_b)),
+            aset.bitmaps, aset.ts, aset.kinds, aset.valid, self._table)
+        matrix = np.asarray(matrix)[:b, :len(aset.txn_ids)]
+        out: List[Deps] = []
+        for i, (subj_id, subj_keys, _) in enumerate(subjects):
+            kb = KeyDepsBuilder()
+            subj_set = set(subj_keys)
+            for j in np.nonzero(matrix[i])[0]:
+                dep_id = aset.txn_ids[j]
+                if dep_id == subj_id:
+                    continue  # device compares by (ts) bound; exclude self
+                # exact per-key recovery: bucket collisions filtered here
+                for k in aset.key_sets[j]:
+                    if k in subj_set:
+                        kb.add(k, dep_id)
+            out.append(Deps(kb.build()))
+        return out

@@ -1,0 +1,3 @@
+from accord_tpu.parallel.mesh import make_mesh, sharded_deps_step
+
+__all__ = ["make_mesh", "sharded_deps_step"]

@@ -25,13 +25,15 @@ from accord_tpu.utils.rng import RandomSource
 class ClusterConfig:
     def __init__(self, num_nodes: int = 3, rf: int = 3, num_shards: int = 4,
                  key_domain: int = 1 << 16, stores_per_node: int = 2,
-                 timeout_ms: float = 1000.0):
+                 timeout_ms: float = 1000.0, deps_resolver_factory=None):
         self.num_nodes = num_nodes
         self.rf = min(rf, num_nodes)
         self.num_shards = num_shards
         self.key_domain = key_domain
         self.stores_per_node = stores_per_node
         self.timeout_ms = timeout_ms
+        # factory() -> DepsResolver; None = host scan (the reference path)
+        self.deps_resolver_factory = deps_resolver_factory
 
 
 def build_topology(cfg: ClusterConfig, epoch: int = 1) -> Topology:
@@ -105,6 +107,8 @@ class Cluster:
                 time_service=self.time_service,
                 data_store=store,
                 num_stores=self.config.stores_per_node,
+                deps_resolver=(self.config.deps_resolver_factory()
+                               if self.config.deps_resolver_factory else None),
             )
             self.nodes[node_id] = node
             self.stores[node_id] = store

@@ -1,10 +1,18 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh so sharding
-tests run without TPU hardware (the driver separately validates the multi-chip
-path via __graft_entry__.dryrun_multichip)."""
+tests run hermetically without TPU hardware (the driver separately validates
+the multi-chip path via __graft_entry__.dryrun_multichip).
+
+Note: this machine's site customization registers an 'axon' TPU backend and
+hard-sets jax.config.jax_platforms, so the env var alone is not enough -- we
+must update the config after importing jax (before any backend init).
+"""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("ACCORD_TPU_PARANOIA", "superlinear")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
