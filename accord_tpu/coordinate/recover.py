@@ -1,0 +1,638 @@
+"""Recovery coordination: Recover, Invalidate, MaybeRecover.
+
+Role-equivalent to the reference's coordinate/Recover.java:80,
+Invalidate.java:50 and MaybeRecover.java:39. Any node may recover a stalled
+transaction by taking a ballot above every previous round:
+
+  BeginRecovery to all replicas of txnId.epoch -> RecoveryTracker quorum ->
+    most advanced known state decides where to resume:
+      INVALIDATED            -> broadcast CommitInvalidate
+      >= STABLE              -> re-execute at the decided (executeAt, deps)
+      COMMITTED/PRE_COMMITTED-> stabilise+execute with committed deps
+                                (CollectDeps for shards lacking coverage)
+      ACCEPTED               -> re-propose the accepted (executeAt, proposal)
+      ACCEPTED_INVALIDATE    -> finish the invalidation
+      all <= PRE_ACCEPTED    -> the whitepaper's fast-path reasoning:
+          if the tracker or any replica proves the fast path impossible
+            -> invalidate
+          else if earlier-accepted-no-witness txns exist -> await their
+            commit, then retry (they could still commit without witnessing
+            us, which would flip the decision)
+          else -> propose executeAt = txnId (the fast path decision the
+            original coordinator would have taken)
+"""
+from __future__ import annotations
+
+import enum
+from typing import Dict, List, Optional, Tuple
+
+from accord_tpu.coordinate.errors import Exhausted, Invalidated, Preempted, Timeout
+from accord_tpu.coordinate.tracking import QuorumTracker, RecoveryTracker, RequestStatus
+from accord_tpu.local.status import Status
+from accord_tpu.messages.base import Callback
+from accord_tpu.messages.recover import (
+    AcceptInvalidate, BeginRecovery, CheckStatus, CheckStatusOk, CommitInvalidate,
+    DepsTier, InvalidateNack, InvalidateOk, RecoverNack, RecoverOk,
+    WaitOnCommit, WaitOnCommitOk,
+)
+from accord_tpu.primitives.deps import Deps
+from accord_tpu.primitives.keyspace import Keys, Ranges, Seekables
+from accord_tpu.primitives.routes import Route
+from accord_tpu.primitives.timestamp import Ballot, Timestamp, TxnId
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.utils.async_ import AsyncResult
+from accord_tpu.utils.invariants import Invariants
+
+
+class Outcome(enum.Enum):
+    """What recovery concluded (reference: ProgressToken)."""
+    APPLIED = "applied"
+    INVALIDATED = "invalidated"
+    TRUNCATED = "truncated"
+
+
+class Recover(Callback):
+    def __init__(self, node, txn_id: TxnId, txn: Txn, route: Route,
+                 ballot: Ballot):
+        self.node = node
+        self.txn_id = txn_id
+        self.txn = txn
+        self.route = route
+        self.ballot = ballot
+        self.result: AsyncResult = AsyncResult()
+        self.topologies = node.topology_manager.with_unsynced_epochs(
+            route, txn_id.epoch, txn_id.epoch)
+        self.topology = self.topologies.for_epoch(txn_id.epoch)
+        self.tracker = RecoveryTracker(self.topologies, txn.keys)
+        self.oks: Dict[int, RecoverOk] = {}
+        self._decided = False
+
+    @classmethod
+    def recover(cls, node, txn_id: TxnId, txn: Txn, route: Route,
+                ballot: Optional[Ballot] = None) -> AsyncResult:
+        if ballot is None:
+            ballot = Ballot.from_timestamp(node.unique_now())
+        self = cls(node, txn_id, txn, route, ballot)
+        node.events.on_recover(txn_id)
+        for to in self.tracker.nodes():
+            node.send(to, BeginRecovery(txn_id, txn, route, ballot), self)
+        return self.result
+
+    # -- BeginRecovery round -------------------------------------------------
+    def on_success(self, from_node, reply) -> None:
+        if self._decided or self.result.done:
+            return
+        if isinstance(reply, RecoverNack):
+            self.node.events.on_preempted(self.txn_id)
+            self.result.try_set_failure(Preempted(
+                f"recovery of {self.txn_id} superseded by {reply.superseded_by}"))
+            return
+        assert isinstance(reply, RecoverOk)
+        self.oks[from_node] = reply
+        if self.tracker.on_success(from_node, reply.is_fast_path_vote) \
+                == RequestStatus.SUCCESS:
+            self._recover()
+
+    def on_failure(self, from_node, failure) -> None:
+        if self._decided or self.result.done:
+            return
+        if self.tracker.on_failure(from_node) == RequestStatus.FAILED:
+            self.result.try_set_failure(Timeout(f"recover {self.txn_id}"))
+
+    # -- the decision (reference: Recover.recover, coordinate/Recover.java:246)
+    def _recover(self) -> None:
+        self._decided = True
+        oks = list(self.oks.values())
+        best = max(oks, key=lambda ok: (ok.status, ok.accepted_ballot))
+        status = best.status
+
+        if status == Status.TRUNCATED:
+            self.result.try_set_success(Outcome.TRUNCATED)
+            return
+        if status == Status.INVALIDATED:
+            self._commit_invalidate()
+            return
+        if status.has_been(Status.STABLE):
+            self._with_committed_deps(
+                best.execute_at,
+                lambda deps: self._resume("execute", best.execute_at, deps))
+            return
+        if status in (Status.COMMITTED, Status.PRE_COMMITTED):
+            self._with_committed_deps(
+                best.execute_at,
+                lambda deps: self._resume("execute", best.execute_at, deps))
+            return
+        if status == Status.ACCEPTED:
+            deps = self._merge_proposal()
+            self._resume("propose", best.execute_at, deps)
+            return
+        if status == Status.ACCEPTED_INVALIDATE:
+            self._invalidate()
+            return
+
+        # nothing beyond PreAccepted anywhere: fast-path reasoning
+        if self.tracker.rejects_fast_path() \
+                or any(ok.rejects_fast_path for ok in oks):
+            self._invalidate()
+            return
+        eanw = Deps.merge([ok.earlier_accepted_no_witness for ok in oks])
+        ecw = Deps.merge([ok.earlier_committed_witness for ok in oks])
+        eanw = eanw.without(ecw.contains)
+        if not eanw.is_empty():
+            self._await_commits(eanw)
+            return
+        deps = self._merge_proposal()
+        self._resume("propose", self.txn_id.as_timestamp(), deps)
+
+    # -- deps reconstruction (reference: LatestDeps merge semantics) ---------
+    # Entries arrive per STORE (sub-shard granularity), so the merge must
+    # resolve the best (tier, ballot) per atomic covering fragment -- taking
+    # the max at whole-shard granularity would silently drop deps for the
+    # store slices the winning entry does not cover.
+    def _entries_for_shard(self, shard) -> List:
+        window = Ranges([shard.range])
+        out = []
+        for node_id in shard.nodes:
+            ok = self.oks.get(node_id)
+            if ok is None:
+                continue
+            for e in ok.deps_entries:
+                if e.covering.intersects(window):
+                    out.append(e)
+        return out
+
+    def _merge_latest(self, entries, window: Ranges,
+                      tier_floor: Optional[DepsTier] = None) -> Tuple[Deps, List[Ranges]]:
+        """Resolve per atomic fragment of `window`: among entries covering the
+        fragment (at/above tier_floor if given), the max (tier, ballot)
+        entries win and their slices union. Returns (deps, fragments with no
+        eligible entry)."""
+        out = Deps.NONE
+        missing: List[Ranges] = []
+        for atom in _atoms(window, [e.covering for e in entries]):
+            cand = [e for e in entries if e.covering.intersects(atom)]
+            if tier_floor is not None:
+                cand = [e for e in cand if e.tier >= tier_floor]
+            if not cand:
+                missing.append(atom)
+                continue
+            top = max((e.tier, e.ballot) for e in cand)
+            parts = [e.deps.slice(atom) for e in cand if (e.tier, e.ballot) == top]
+            out = out.union(Deps.merge(parts))
+        return out, missing
+
+    def _merge_proposal(self) -> Deps:
+        """Best-known deps: highest (tier, ballot) per fragment
+        (reference: LatestDeps.mergeProposal)."""
+        out = Deps.NONE
+        for shard in self.topology.shards_for(self.txn.keys):
+            deps, _ = self._merge_latest(self._entries_for_shard(shard),
+                                         Ranges([shard.range]))
+            out = out.union(deps)
+        return out
+
+    def _with_committed_deps(self, execute_at: Timestamp, then) -> None:
+        """Union of committed-tier deps, topping up fragments without
+        committed coverage via a fresh GetDeps round at executeAt (reference:
+        Recover.withCommittedDeps + CollectDeps.java:39)."""
+        out = Deps.NONE
+        missing: List[Ranges] = []
+        for shard in self.topology.shards_for(self.txn.keys):
+            deps, miss = self._merge_latest(self._entries_for_shard(shard),
+                                            Ranges([shard.range]),
+                                            tier_floor=DepsTier.COMMITTED)
+            out = out.union(deps)
+            missing.extend(miss)
+        if not missing:
+            then(out)
+            return
+        window = Ranges.EMPTY
+        for m in missing:
+            window = window.union(m)
+        keys = _slice_seekables(self.txn.keys, window)
+        if keys.is_empty():
+            then(out)
+            return
+        CollectDeps.collect(self.node, self.txn_id, keys, execute_at) \
+            .on_success(lambda extra: then(out.union(extra))) \
+            .on_failure(self.result.try_set_failure)
+
+    # -- resumption via the standard coordination rounds ---------------------
+    def _resume(self, phase: str, execute_at: Timestamp, deps: Deps) -> None:
+        from accord_tpu.coordinate.transaction import CoordinateTransaction
+        CoordinateTransaction.resume(
+            self.node, self.txn_id, self.txn, self.route, self.ballot,
+            phase, execute_at, deps,
+        ).on_success(lambda _: self.result.try_set_success(Outcome.APPLIED)) \
+         .on_failure(self.result.try_set_failure)
+
+    # -- invalidation --------------------------------------------------------
+    def _invalidate(self) -> None:
+        propose_invalidate(self.node, self.txn_id, self.ballot,
+                           self.route.home_key) \
+            .on_success(lambda _: self._commit_invalidate()) \
+            .on_failure(self.result.try_set_failure)
+
+    def _commit_invalidate(self) -> None:
+        participants = self.route.participants
+        for to in self.topology.nodes():
+            self.node.send(to, CommitInvalidate(self.txn_id, participants))
+        self.node.events.on_invalidated(self.txn_id)
+        self.result.try_set_success(Outcome.INVALIDATED)
+
+    # -- earlier-accepted-no-witness wait (reference: Recover.AwaitCommit) ---
+    def _await_commits(self, waiting_on: Deps) -> None:
+        ids = waiting_on.all_txn_ids()
+        state = {"remaining": len(ids)}
+
+        def one_done(_):
+            state["remaining"] -= 1
+            if state["remaining"] == 0:
+                self._retry()
+
+        for dep_id in ids:
+            keys = waiting_on.participants_of(dep_id) or self.txn.keys
+            AwaitCommit.start(self.node, dep_id, keys) \
+                .on_success(one_done) \
+                .on_failure(self.result.try_set_failure)
+
+    def _retry(self) -> None:
+        if self.result.done:
+            return
+        Recover.recover(self.node, self.txn_id, self.txn, self.route,
+                        self.ballot) \
+            .add_callback(lambda v, f: self.result.try_set_failure(f)
+                          if f is not None else self.result.try_set_success(v))
+
+
+def _atoms(window: Ranges, coverings: List[Ranges]) -> List[Ranges]:
+    """Split `window` at every covering boundary into atomic fragments, each
+    returned as a single-range Ranges (no entry's covering partially overlaps
+    an atom)."""
+    out: List[Ranges] = []
+    from accord_tpu.primitives.keyspace import Range
+    for w in window:
+        pts = {w.start, w.end}
+        for rngs in coverings:
+            for r in rngs:
+                if r.start > w.start and r.start < w.end:
+                    pts.add(r.start)
+                if r.end > w.start and r.end < w.end:
+                    pts.add(r.end)
+        bounds = sorted(pts)
+        for i in range(len(bounds) - 1):
+            out.append(Ranges([Range(bounds[i], bounds[i + 1])]))
+    return out
+
+
+def _slice_seekables(seekables: Seekables, window: Ranges) -> Seekables:
+    return seekables.slice(window)
+
+
+class CollectDeps(Callback):
+    """Quorum GetDeps round (reference: coordinate/CollectDeps.java:39)."""
+
+    def __init__(self, node, txn_id: TxnId, keys: Seekables, before: Timestamp):
+        self.node = node
+        self.txn_id = txn_id
+        self.result: AsyncResult = AsyncResult()
+        topologies = node.topology_manager.with_unsynced_epochs(
+            Route(None, keys), txn_id.epoch, txn_id.epoch)
+        self.tracker = QuorumTracker(topologies, keys)
+        self.keys = keys
+        self.before = before
+        self.deps = Deps.NONE
+
+    @classmethod
+    def collect(cls, node, txn_id: TxnId, keys: Seekables,
+                before: Timestamp) -> AsyncResult:
+        from accord_tpu.messages.getdeps import GetDeps
+        self = cls(node, txn_id, keys, before)
+        for to in self.tracker.nodes():
+            node.send(to, GetDeps(txn_id, keys, before), self)
+        return self.result
+
+    def on_success(self, from_node, reply) -> None:
+        if self.result.done:
+            return
+        self.deps = self.deps.union(reply.deps)
+        if self.tracker.on_success(from_node) == RequestStatus.SUCCESS:
+            self.result.try_set_success(self.deps)
+
+    def on_failure(self, from_node, failure) -> None:
+        if self.result.done:
+            return
+        if self.tracker.on_failure(from_node) == RequestStatus.FAILED:
+            self.result.try_set_failure(Timeout(f"collectDeps {self.txn_id}"))
+
+
+class AwaitCommit(Callback):
+    """Quorum WaitOnCommit for one txn (reference: Recover.AwaitCommit)."""
+
+    def __init__(self, node, txn_id: TxnId, participants: Seekables):
+        self.result = AsyncResult()
+        topologies = node.topology_manager.with_unsynced_epochs(
+            Route(None, participants), txn_id.epoch, txn_id.epoch)
+        self.tracker = QuorumTracker(topologies, participants)
+        self.txn_id = txn_id
+
+    @classmethod
+    def start(cls, node, txn_id: TxnId, participants: Seekables) -> AsyncResult:
+        self = cls(node, txn_id, participants)
+        for to in self.tracker.nodes():
+            node.send(to, WaitOnCommit(txn_id, participants), self)
+        return self.result
+
+    def on_success(self, from_node, reply) -> None:
+        if self.tracker.on_success(from_node) == RequestStatus.SUCCESS:
+            self.result.try_set_success(None)
+
+    def on_failure(self, from_node, failure) -> None:
+        if self.tracker.on_failure(from_node) == RequestStatus.FAILED:
+            self.result.try_set_failure(Timeout(f"awaitCommit {self.txn_id}"))
+
+
+class WitnessedElsewhere(RuntimeError):
+    """An invalidation attempt found the txn witnessed: recover it instead
+    (reference: Invalidate.java switches to RecoverWithRoute)."""
+
+    def __init__(self, txn_id: TxnId, status: Status, route: Optional[Route]):
+        super().__init__(f"{txn_id} witnessed at {status.name}")
+        self.status = status
+        self.route = route
+
+
+def propose_invalidate(node, txn_id: TxnId, ballot: Ballot, key,
+                       abort_if_witnessed: bool = False) -> AsyncResult:
+    """Ballot-accept invalidation on the quorum of one shard (reference:
+    Propose.Invalidate.proposeInvalidate): that shard's quorum participates in
+    any commit of txn_id, so a promised invalidation there blocks them all.
+
+    With abort_if_witnessed (the blind Invalidate path, where nothing proves
+    the fast path impossible), ANY witness aborts with WitnessedElsewhere:
+    the txn's coordinator may still be concurrently fast-committing, and only
+    a full BeginRecovery round can reason about that safely."""
+    topology = node.topology_manager.for_epoch(txn_id.epoch)
+    shard = topology.shard_for_key(key)
+    tracker = QuorumTracker(
+        node.topology_manager.with_unsynced_epochs(
+            Route(key, Keys([key])), txn_id.epoch, txn_id.epoch),
+        Keys([key]))
+    result = AsyncResult()
+
+    class Cb(Callback):
+        def on_success(self, from_node, reply) -> None:
+            if result.done:
+                return
+            if isinstance(reply, InvalidateNack):
+                result.try_set_failure(Preempted(
+                    f"invalidate {txn_id} superseded by {reply.promised}"))
+                return
+            if reply.status.is_decided and not reply.status.is_terminal:
+                # the txn got committed while we tried to invalidate it
+                result.try_set_failure(Preempted(
+                    f"invalidate {txn_id}: already decided ({reply.status.name})"))
+                return
+            if abort_if_witnessed and reply.status.has_been(Status.PRE_ACCEPTED) \
+                    and reply.status != Status.ACCEPTED_INVALIDATE \
+                    and not reply.status.is_terminal:
+                result.try_set_failure(
+                    WitnessedElsewhere(txn_id, reply.status, reply.route))
+                return
+            if tracker.on_success(from_node) == RequestStatus.SUCCESS:
+                result.try_set_success(None)
+
+        def on_failure(self, from_node, failure) -> None:
+            if tracker.on_failure(from_node) == RequestStatus.FAILED:
+                result.try_set_failure(Timeout(f"invalidate {txn_id}"))
+
+    cb = Cb()
+    for to in shard.nodes:
+        node.send(to, AcceptInvalidate(txn_id, ballot, key), cb)
+    return result
+
+
+def invalidate_unwitnessed(node, txn_id: TxnId, participants: Seekables) -> AsyncResult:
+    """Invalidate a txn known only by id (no definition/route reachable) --
+    reference: Invalidate.java:50. Uses any key it was seen under. If a
+    witness surfaces, falls back to probing (and hence recovering) it."""
+    ballot = Ballot.from_timestamp(node.unique_now())
+    some_key = next(iter(participants)) if isinstance(participants, Keys) \
+        else participants[0].start
+    result = AsyncResult()
+
+    def committed(_):
+        topology = node.topology_manager.for_epoch(txn_id.epoch)
+        for to in topology.nodes():
+            node.send(to, CommitInvalidate(txn_id, participants))
+        result.try_set_success(Outcome.INVALIDATED)
+
+    def failed(failure):
+        if isinstance(failure, WitnessedElsewhere):
+            # re-probe WITHOUT permission to invalidate again: breaks the
+            # probe->invalidate->probe mutual recursion; the progress engine
+            # retries from scratch later if this pass can't resolve it
+            scope = failure.route.participants if failure.route is not None \
+                else participants
+            MaybeRecover.probe(node, txn_id, scope, allow_invalidate=False) \
+                .add_callback(
+                    lambda v, f: result.try_set_failure(f) if f is not None
+                    else result.try_set_success(v))
+        else:
+            result.try_set_failure(failure)
+
+    propose_invalidate(node, txn_id, ballot, some_key,
+                       abort_if_witnessed=True) \
+        .on_success(committed) \
+        .on_failure(failed)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# MaybeRecover: probe, repair locally, or escalate
+# ---------------------------------------------------------------------------
+
+class MaybeRecover(Callback):
+    """CheckStatus probe for a stalled txn; apply anything learned locally
+    (the reference's Propagate), else escalate to full Recover/Invalidate
+    (reference: MaybeRecover.java:39, RecoverWithRoute.java:57).
+
+    Positive knowledge (an outcome, an invalidation) acts as soon as it
+    arrives with a quorum; NEGATIVE decisions (recover from scratch,
+    invalidate an apparently-unwitnessed txn) wait for every reachable reply,
+    because a bare quorum can simply have missed the one witness."""
+
+    def __init__(self, node, txn_id: TxnId, participants: Seekables,
+                 allow_invalidate: bool):
+        self.node = node
+        self.txn_id = txn_id
+        self.participants = participants
+        self.allow_invalidate = allow_invalidate
+        self.result: AsyncResult = AsyncResult()
+        self.topologies = node.topology_manager.with_unsynced_epochs(
+            Route(None, participants), txn_id.epoch, txn_id.epoch)
+        self.tracker = QuorumTracker(self.topologies, participants)
+        self.oks: List[CheckStatusOk] = []
+        self.contacted = 0
+        self.answered = 0
+        self._acted = False
+
+    @classmethod
+    def probe(cls, node, txn_id: TxnId, participants: Seekables,
+              allow_invalidate: bool = True) -> AsyncResult:
+        self = cls(node, txn_id, participants, allow_invalidate)
+        targets = self.tracker.nodes()
+        self.contacted = len(targets)
+        for to in targets:
+            node.send(to, CheckStatus(txn_id, participants), self)
+        return self.result
+
+    def on_success(self, from_node, reply) -> None:
+        if self._acted:
+            return
+        self.oks.append(reply)
+        self.answered += 1
+        self.tracker.on_success(from_node)
+        self._maybe_act()
+
+    def on_failure(self, from_node, failure) -> None:
+        if self._acted:
+            return
+        self.answered += 1
+        if self.tracker.on_failure(from_node) == RequestStatus.FAILED:
+            self._acted = True
+            self.result.try_set_failure(Timeout(f"checkStatus {self.txn_id}"))
+            return
+        self._maybe_act()
+
+    def _merged(self) -> CheckStatusOk:
+        merged = self.oks[0]
+        for ok in self.oks[1:]:
+            merged = CheckStatusOk.merge(merged, ok)
+        return merged
+
+    def _maybe_act(self) -> None:
+        if not self.oks:
+            if self.answered >= self.contacted:
+                self._acted = True
+                self.result.try_set_failure(Timeout(f"checkStatus {self.txn_id}"))
+            return
+        merged = self._merged()
+        have_quorum = self.tracker.decided == RequestStatus.SUCCESS
+        all_in = self.answered >= self.contacted
+
+        # positive knowledge: act as soon as it is quorum-confirmed reachable
+        if have_quorum and merged.status == Status.INVALIDATED:
+            self._acted = True
+            self._propagate_invalidate(merged)
+            return
+        if have_quorum and merged.status.has_been(Status.PRE_APPLIED) \
+                and not merged.status.is_terminal:
+            self._acted = True
+            self._propagate_outcome(merged)
+            return
+        if not all_in:
+            return  # wait for the stragglers before a negative decision
+        if not have_quorum:
+            self._acted = True
+            self.result.try_set_failure(Timeout(f"checkStatus {self.txn_id}"))
+            return
+        self._acted = True
+        if merged.status.has_been(Status.PRE_APPLIED) \
+                and not merged.status.is_terminal:
+            self._propagate_outcome(merged)
+            return
+        if merged.status == Status.INVALIDATED:
+            self._propagate_invalidate(merged)
+            return
+        if merged.route is not None and merged.partial_txn is not None \
+                and merged.partial_txn.covers(merged.route.covering()):
+            txn = merged.partial_txn.reconstitute()
+            Recover.recover(self.node, self.txn_id, txn, merged.route) \
+                .add_callback(self._finish)
+            return
+        if merged.route is not None \
+                and not merged.route.covering().contains_ranges(
+                    _to_ranges(self.participants)):
+            # learn the full participant set, then retry with the full route
+            MaybeRecover.probe(self.node, self.txn_id,
+                               merged.route.participants,
+                               self.allow_invalidate) \
+                .add_callback(self._finish)
+            return
+        if not self.allow_invalidate:
+            self.result.try_set_failure(Exhausted(
+                f"probe {self.txn_id}: witnessed but unrecoverable yet"))
+            return
+        # no replica knows the definition: race to invalidate it
+        invalidate_unwitnessed(self.node, self.txn_id, self.participants) \
+            .add_callback(self._finish)
+
+    def _finish(self, value, failure) -> None:
+        if failure is not None:
+            self.result.try_set_failure(failure)
+        else:
+            self.result.try_set_success(value)
+
+    # -- Propagate (reference: messages/Propagate.java:64) -------------------
+    def _propagate_invalidate(self, merged: Optional[CheckStatusOk] = None) -> None:
+        from accord_tpu.local import commands
+        scope = self.participants
+        if merged is not None and merged.route is not None:
+            scope = merged.route.participants
+        for store in self.node.command_stores.all():
+            if store.owns(scope) or store.owns(self.participants):
+                commands.commit_invalidate(store, self.txn_id)
+        self.result.try_set_success(Outcome.INVALIDATED)
+
+    def _propagate_outcome(self, merged: CheckStatusOk) -> None:
+        """Apply a remotely-known outcome to our local stores. Writes in a
+        reply are the sender's slice, so each store only accepts replies whose
+        writes cover that store's slice of the participants."""
+        from accord_tpu.local import commands
+        applied_any = False
+        # the full participant set: self.participants may be only where a
+        # blocked dep was SEEN, and applying a store's slice partially while
+        # marking the command APPLIED would silently lose writes
+        scope = merged.route.participants if merged.route is not None \
+            else self.participants
+        for store in self.node.command_stores.all():
+            if not store.owns(scope):
+                continue
+            # a reply's txn/writes are the SENDER's slice; only accept one
+            # whose coverage includes this store's slice of the participants
+            need = _to_ranges(store.owned(scope))
+            for ok in sorted((o for o in self.oks
+                              if o.status.has_been(Status.PRE_APPLIED)
+                              and not o.status.is_terminal
+                              and o.partial_txn is not None),
+                             key=lambda o: o.status, reverse=True):
+                if not ok.partial_txn.covers(need):
+                    continue
+                w = ok.writes
+                partial = ok.partial_txn.slice(store.ranges, include_query=False)
+                deps = (ok.stable_deps or Deps.NONE).slice(store.ranges)
+                commands.apply(store, self.txn_id, merged.route or ok.route,
+                               partial, ok.execute_at, deps,
+                               w.slice(store.ranges) if w is not None else None,
+                               ok.result)
+                applied_any = True
+                break
+        if applied_any:
+            self.result.try_set_success(Outcome.APPLIED)
+        else:
+            # outcome exists but no reply covers us: recover (re-executes)
+            if merged.route is not None and merged.partial_txn is not None \
+                    and merged.partial_txn.covers(merged.route.covering()):
+                Recover.recover(self.node, self.txn_id,
+                                merged.partial_txn.reconstitute(), merged.route) \
+                    .add_callback(self._finish)
+            else:
+                self.result.try_set_failure(Exhausted(
+                    f"propagate {self.txn_id}: no covering outcome"))
+
+
+def _to_ranges(seekables: Seekables) -> Ranges:
+    if isinstance(seekables, Ranges):
+        return seekables
+    return seekables.to_ranges()

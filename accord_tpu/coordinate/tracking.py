@@ -183,5 +183,31 @@ class ReadTracker(AbstractTracker):
         return len(self._data) == len(self.shards)
 
 
+class RecoveryTracker(AbstractTracker):
+    """Quorum per shard, additionally counting fast-path-electorate members
+    whose witnessed timestamp differs from txnId (reference:
+    RecoveryTracker.java:26): once more electorate members reject than the
+    electorate could spare, the original fast path provably never happened."""
+
+    def on_success(self, node: NodeId, fast_path_vote: bool) -> RequestStatus:
+        for st in self._by_node.get(node, ()):
+            st.successes.add(node)
+            if not fast_path_vote and node in st.shard.fast_path_electorate:
+                st.fast_rejects.add(node)
+        return self._decide()
+
+    def _is_success(self) -> bool:
+        return all(s.has_quorum() for s in self.shards)
+
+    def rejects_fast_path(self) -> bool:
+        # only POSITIVE rejects count: a failed/timed-out electorate member
+        # (added to fast_rejects by on_failure) proves nothing about the
+        # original fast path, so exclude failures from the impossibility math
+        return any(
+            st.shard.rejects_fast_path(
+                len((st.fast_rejects & st.shard.fast_path_electorate) - st.failures))
+            for st in self.shards)
+
+
 class AppliedTracker(QuorumTracker):
     """Quorum of Apply acks per shard (durability tracking)."""

@@ -36,11 +36,13 @@ from accord_tpu.utils.invariants import Invariants
 
 
 class CoordinateTransaction:
-    def __init__(self, node, txn_id: TxnId, txn: Txn, route: Route):
+    def __init__(self, node, txn_id: TxnId, txn: Txn, route: Route,
+                 ballot: Ballot = Ballot.ZERO):
         self.node = node
         self.txn_id = txn_id
         self.txn = txn
         self.route = route
+        self.ballot = ballot  # > ZERO when driven by recovery
         self.result: AsyncResult = AsyncResult()
         self.topologies = node.topology_manager.with_unsynced_epochs(
             route, txn_id.epoch, txn_id.epoch)
@@ -51,6 +53,23 @@ class CoordinateTransaction:
     def coordinate(cls, node, txn_id: TxnId, txn: Txn, route: Route) -> AsyncResult:
         self = cls(node, txn_id, txn, route)
         self._start_preaccept()
+        return self.result
+
+    @classmethod
+    def resume(cls, node, txn_id: TxnId, txn: Txn, route: Route, ballot: Ballot,
+               phase: str, execute_at: Timestamp, deps: Deps) -> AsyncResult:
+        """Entry point for recovery: re-drive the protocol from `phase`
+        ('propose' -> Accept round; 'execute' -> Commit(Stable)+read) with the
+        recovery's ballot and reconstructed (executeAt, deps)
+        (reference: RecoveryTxnAdapter, coordinate/CoordinationAdapter.java:195)."""
+        self = cls(node, txn_id, txn, route, ballot)
+        self.execute_at = execute_at
+        self.deps = deps
+        if phase == "propose":
+            self._start_propose()
+        else:
+            Invariants.check_argument(phase == "execute", "unknown phase %s", phase)
+            self._start_execute()
         return self.result
 
     # -- phase 1: PreAccept --------------------------------------------------
@@ -81,8 +100,9 @@ class CoordinateTransaction:
     def _start_propose(self) -> None:
         round_ = _ProposeRound(self)
         for to in round_.tracker.nodes():
-            self.node.send(to, Accept(self.txn_id, Ballot.ZERO, self.route,
-                                      self.txn.keys, self.execute_at), round_)
+            self.node.send(to, Accept(self.txn_id, self.ballot, self.route,
+                                      self.txn.keys, self.execute_at,
+                                      self.deps), round_)
 
     def _on_accepted(self, round_: "_ProposeRound") -> None:
         self.deps = Deps.merge([self.deps] + [ok.deps for ok in round_.oks.values()])
@@ -100,11 +120,8 @@ class CoordinateTransaction:
     # -- phase 4: Persist (off the client latency path) ----------------------
     def _persist(self, writes, result) -> None:
         self.result.try_set_success(result)
-        round_ = _ApplyRound(self)
-        for to in round_.tracker.nodes():
-            self.node.send(to, Apply(self.txn_id, self.route, self.txn,
-                                     self.execute_at, self.deps, writes, result),
-                           round_)
+        round_ = _ApplyRound(self, writes, result)
+        round_.start()
 
     # -- shared failure handling ---------------------------------------------
     def _fail(self, failure: BaseException) -> None:
@@ -254,18 +271,44 @@ class _ExecuteRound(Callback):
 
 
 class _ApplyRound(Callback):
-    """Background durability tracking; the client already has its result."""
+    """Background durability: broadcast Apply and retry per-node until every
+    replica acks (bounded attempts). The client already has its result; these
+    retries are what keep stragglers convergent when Apply messages drop
+    (until durability rounds land, this is the reference's
+    persist-then-informDurable role)."""
 
-    def __init__(self, parent: CoordinateTransaction):
+    # the sim has no permanent node failures, so persist keeps retrying
+    # through long partitions; durability rounds will replace this crutch
+    MAX_ATTEMPTS = 64
+
+    def __init__(self, parent: CoordinateTransaction, writes, result):
         self.parent = parent
+        self.writes = writes
+        self.result = result
         self.tracker = AppliedTracker(parent.topologies, parent.txn.keys)
+        self.acked: set = set()
+        self.attempts: Dict[int, int] = {}
+
+    def _message(self) -> Apply:
+        p = self.parent
+        return Apply(p.txn_id, p.route, p.txn, p.execute_at, p.deps,
+                     self.writes, self.result)
+
+    def start(self) -> None:
+        for to in self.tracker.nodes():
+            self.attempts[to] = 1
+            self.parent.node.send(to, self._message(), self)
 
     def on_success(self, from_node, reply) -> None:
-        status = self.tracker.on_success(from_node)
-        if status == RequestStatus.SUCCESS:
-            # durability quorum reached; home-shard durability gossip lands
-            # with the recovery/durability milestone
-            pass
+        self.acked.add(from_node)
+        self.tracker.on_success(from_node)
 
     def on_failure(self, from_node, failure) -> None:
-        self.tracker.on_failure(from_node)
+        if from_node in self.acked:
+            return
+        n = self.attempts.get(from_node, 0)
+        if n >= self.MAX_ATTEMPTS:
+            self.tracker.on_failure(from_node)
+            return
+        self.attempts[from_node] = n + 1
+        self.parent.node.send(from_node, self._message(), self)

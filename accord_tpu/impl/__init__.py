@@ -1,0 +1,1 @@
+"""Default implementations of the SPI layer (reference: accord.impl)."""

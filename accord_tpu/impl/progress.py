@@ -1,0 +1,196 @@
+"""The liveness engine: notice stalled transactions and drive recovery.
+
+Role-equivalent to the reference's SimpleProgressLog (impl/
+SimpleProgressLog.java:78): every CommandStore reports command lifecycle
+events here; entries that stop progressing past a stall threshold get a
+CheckStatus probe (MaybeRecover) that either repairs local knowledge
+(Propagate) or escalates to full Recover/Invalidate. One engine per node;
+the per-store ProgressLog facade tags events with their store.
+
+Tracked entries:
+  - home-shard commands from preaccept onwards (the home shard owns each
+    txn's liveness, reference ProgressShard.Home),
+  - every replica's stable-but-not-applied commands (straggler repair),
+  - dependencies a local command is blocked waiting on (reference Blocked
+    state machine).
+
+Scheduling is event-driven: a check tick is queued only while entries exist,
+so a quiesced cluster's event queue drains (which the burn test relies on).
+Attempts back off exponentially with deterministic jitter.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from accord_tpu.api import ProgressLog
+from accord_tpu.local.status import Status
+from accord_tpu.primitives.keyspace import Seekables
+from accord_tpu.primitives.timestamp import TxnId
+
+
+class _Tracked:
+    __slots__ = ("txn_id", "participants", "last_status", "last_change_ms",
+                 "attempts", "next_attempt_ms", "in_flight")
+
+    def __init__(self, txn_id: TxnId, participants, status: Status, now_ms: float):
+        self.txn_id = txn_id
+        self.participants = participants
+        self.last_status = status
+        self.last_change_ms = now_ms
+        self.attempts = 0
+        self.next_attempt_ms = 0.0
+        self.in_flight = False
+
+
+class ProgressEngine:
+    def __init__(self, node=None, interval_ms: float = 250.0,
+                 stall_ms: float = 1500.0):
+        self.node = None
+        self.rng = None
+        self.interval_ms = interval_ms
+        self.stall_ms = stall_ms
+        self.tracked: Dict[TxnId, _Tracked] = {}
+        self._scheduled = False
+        if node is not None:
+            self.bind(node)
+
+    def bind(self, node) -> None:
+        """Late binding: store factories need the engine before the Node
+        object exists (Node builds its stores in its constructor)."""
+        self.node = node
+        self.rng = node.rng.fork()
+
+    def log_for(self, store) -> "StoreProgressLog":
+        return StoreProgressLog(self, store)
+
+    # -- tracking ------------------------------------------------------------
+    def track(self, txn_id: TxnId, participants: Optional[Seekables],
+              status: Status) -> None:
+        now = self.node.now_millis()
+        entry = self.tracked.get(txn_id)
+        if entry is None:
+            if participants is None:
+                return  # nowhere to address a probe yet
+            entry = _Tracked(txn_id, participants, status, now)
+            entry.next_attempt_ms = now + self.stall_ms + self._jitter()
+            self.tracked[txn_id] = entry
+        else:
+            if participants is not None:
+                entry.participants = participants
+            if status > entry.last_status:
+                # progress: reset the stall clock
+                entry.last_status = status
+                entry.last_change_ms = now
+                entry.attempts = 0
+                entry.next_attempt_ms = now + self.stall_ms + self._jitter()
+        self._ensure_scheduled()
+
+    def clear(self, txn_id: TxnId) -> None:
+        """A store reports the txn locally finished. The engine is node-wide
+        while commands advance per-store, so only drop the entry once EVERY
+        owning store is applied/terminal; otherwise leave it for the tick
+        loop to re-check."""
+        entry = self.tracked.get(txn_id)
+        if entry is not None and self._locally_resolved(entry):
+            self.tracked.pop(txn_id, None)
+
+    def _jitter(self) -> float:
+        return self.rng.next_int(int(self.stall_ms)) / 2.0
+
+    # -- the check loop ------------------------------------------------------
+    def _ensure_scheduled(self) -> None:
+        if not self._scheduled and self.tracked:
+            self._scheduled = True
+            self.node.scheduler.once(self.interval_ms, self._tick)
+
+    def _tick(self) -> None:
+        self._scheduled = False
+        now = self.node.now_millis()
+        for entry in list(self.tracked.values()):
+            if self._locally_resolved(entry):
+                self.tracked.pop(entry.txn_id, None)
+                continue
+            if entry.in_flight or now < entry.next_attempt_ms:
+                continue
+            self._attempt(entry, now)
+        self._ensure_scheduled()
+
+    def _locally_resolved(self, entry: _Tracked) -> bool:
+        """Done when every local store owning the participants has the command
+        applied or terminal."""
+        any_store = False
+        for store in self.node.command_stores.all():
+            if not store.owns(entry.participants):
+                continue
+            any_store = True
+            cmd = store.command_if_present(entry.txn_id)
+            if cmd is None or not (cmd.has_been(Status.APPLIED)
+                                   or cmd.status.is_terminal):
+                return False
+        return any_store
+
+    def _attempt(self, entry: _Tracked, now: float) -> None:
+        from accord_tpu.coordinate.recover import MaybeRecover
+        entry.in_flight = True
+        entry.attempts += 1
+        backoff = self.stall_ms * (2 ** min(entry.attempts, 4))
+        entry.next_attempt_ms = now + backoff + self._jitter()
+
+        def done(value, failure):
+            entry.in_flight = False
+            self._ensure_scheduled()
+
+        MaybeRecover.probe(self.node, entry.txn_id, entry.participants) \
+            .add_callback(done)
+
+
+class StoreProgressLog(ProgressLog):
+    """Per-store facade feeding the node's single engine."""
+
+    def __init__(self, engine: ProgressEngine, store):
+        self.engine = engine
+        self.store = store
+
+    def _participants(self, command):
+        if command.route is not None:
+            return command.route.participants
+        if command.txn is not None:
+            return command.txn.keys
+        return None
+
+    def preaccepted(self, command, is_home: bool) -> None:
+        if is_home:
+            self.engine.track(command.txn_id, self._participants(command),
+                              command.status)
+
+    def accepted(self, command, is_home: bool) -> None:
+        if is_home:
+            self.engine.track(command.txn_id, self._participants(command),
+                              command.status)
+
+    def committed(self, command, is_home: bool) -> None:
+        self.engine.track(command.txn_id, self._participants(command),
+                          command.status)
+
+    def stable(self, command, is_home: bool) -> None:
+        # every replica watches stable-but-unapplied commands: this is what
+        # repairs stragglers that missed the Apply broadcast
+        self.engine.track(command.txn_id, self._participants(command),
+                          command.status)
+
+    def readyToExecute(self, command) -> None:
+        self.engine.track(command.txn_id, self._participants(command),
+                          command.status)
+
+    def executed(self, command, is_home: bool) -> None:
+        self.engine.track(command.txn_id, self._participants(command),
+                          command.status)
+
+    def durable(self, command) -> None:
+        self.engine.clear(command.txn_id)
+
+    def waiting(self, blocked_by: TxnId, blocked_until, participants) -> None:
+        self.engine.track(blocked_by, participants, Status.NOT_DEFINED)
+
+    def clear(self, txn_id: TxnId) -> None:
+        self.engine.clear(txn_id)

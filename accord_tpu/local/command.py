@@ -104,7 +104,14 @@ class Command:
         return self.writes is not None or self.is_(Status.INVALIDATED)
 
     def is_ready_to_execute(self) -> bool:
-        return self.status == Status.READY_TO_EXECUTE or self.has_been(Status.PRE_APPLIED)
+        """May a read at executeAt run against the data store now? Only once
+        every local dependency has applied: READY_TO_EXECUTE (stable, deps
+        done) or APPLIED. PRE_APPLIED is NOT enough -- the outcome is known
+        but earlier writes may still be pending locally, and reading then
+        returns stale data (reference: ReadData waits for the
+        SaveStatus.ExecuteOn window, messages/ReadData.java:53)."""
+        return self.status == Status.READY_TO_EXECUTE \
+            or self.status == Status.APPLIED
 
     # -- listeners -----------------------------------------------------------
     def add_waiter(self, txn_id: TxnId) -> None:

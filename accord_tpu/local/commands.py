@@ -76,8 +76,11 @@ def preaccept(store: CommandStore, txn_id: TxnId, txn: PartialTxn, route: Route,
 # ---------------------------------------------------------------------------
 
 def accept(store: CommandStore, txn_id: TxnId, ballot: Ballot, route: Route,
-           keys, execute_at: Timestamp) -> AcceptOutcome:
-    """(reference: Commands.accept, local/Commands.java:202)"""
+           keys, execute_at: Timestamp,
+           deps: Optional[Deps] = None) -> AcceptOutcome:
+    """(reference: Commands.accept, local/Commands.java:202). `deps` is the
+    coordinator's proposal, retained so recovery can reconstruct the latest
+    accepted proposal (reference stores partialDeps on the Accepted command)."""
     cmd = store.command(txn_id)
     if cmd.status.is_terminal:
         return AcceptOutcome.REJECTED_BALLOT if cmd.is_(Status.INVALIDATED) \
@@ -92,10 +95,40 @@ def accept(store: CommandStore, txn_id: TxnId, ballot: Ballot, route: Route,
     cmd.execute_at = execute_at
     cmd.promised = ballot
     cmd.accepted_ballot = ballot
+    if deps is not None:
+        cmd.deps = deps.slice(store.ranges)
     cmd.status = Status.ACCEPTED
     store.register(txn_id, keys, CfkStatus.WITNESSED, execute_at)
     store.progress_log.accepted(cmd, _is_home(store, cmd))
     notify_listeners(store, cmd)
+    return AcceptOutcome.SUCCESS
+
+
+def recover(store: CommandStore, txn_id: TxnId, txn: PartialTxn, route: Route,
+            ballot: Ballot) -> AcceptOutcome:
+    """Ballot-gated witness for a BeginRecovery round (reference:
+    Commands.recover via preacceptOrRecover, local/Commands.java:125-200):
+    promise `ballot`, witnessing the txn first if this replica never saw it.
+    The witnessed-timestamp calculation is identical to preaccept, so a fresh
+    witness with no conflicts above txnId still reports a fast-path vote --
+    safe, because genuine fast-quorum members always report their original
+    witnessed timestamp and the recovery tracker's impossibility threshold
+    only counts electorate rejects."""
+    cmd = store.command(txn_id)
+    if cmd.is_(Status.TRUNCATED):
+        return AcceptOutcome.TRUNCATED
+    if cmd.promised > ballot:
+        return AcceptOutcome.REJECTED_BALLOT
+    cmd.promised = ballot
+    if not cmd.known_definition and not cmd.is_(Status.INVALIDATED):
+        cmd.txn = txn
+        cmd.route = route if cmd.route is None else cmd.route
+        witnessed = store.preaccept_timestamp(txn_id, store.owned(txn.keys),
+                                              permit_fast_path=True)
+        cmd.execute_at = witnessed
+        cmd.status = Status.PRE_ACCEPTED
+        store.register(txn_id, txn.keys, CfkStatus.WITNESSED, witnessed)
+        notify_listeners(store, cmd)
     return AcceptOutcome.SUCCESS
 
 
@@ -201,7 +234,8 @@ def apply(store: CommandStore, txn_id: TxnId, route: Route, txn: Optional[Partia
     cmd.route = route if cmd.route is None else cmd.route
     was_stable = cmd.has_been(Status.STABLE)
     cmd.execute_at = execute_at
-    if cmd.deps is None:
+    if not was_stable:
+        # the committed deps supersede any accepted-proposal deps we retained
         cmd.deps = deps
     cmd.writes = writes
     cmd.result = result
@@ -271,9 +305,24 @@ def _report_waiting(store: CommandStore, cmd: Command) -> None:
     wo = cmd.waiting_on
     if wo.commit:
         blocked = min(wo.commit)
-        store.progress_log.waiting(blocked, Status.COMMITTED, None)
+        store.progress_log.waiting(blocked, Status.COMMITTED,
+                                   _dep_participants(store, cmd, blocked))
     elif wo.apply:
-        store.progress_log.waiting(min(wo.apply), Status.APPLIED, None)
+        blocked = min(wo.apply)
+        store.progress_log.waiting(blocked, Status.APPLIED,
+                                   _dep_participants(store, cmd, blocked))
+
+
+def _dep_participants(store: CommandStore, cmd: Command, dep_id: TxnId):
+    """Where (which keys) the blocking dependency is known to participate --
+    the shards a CheckStatus/recovery probe for it must contact. Prefer the
+    dep's own witnessed route; fall back to the waiter's deps index."""
+    dep = store.command_if_present(dep_id)
+    if dep is not None and dep.route is not None:
+        return dep.route.participants
+    if cmd.deps is not None:
+        return cmd.deps.participants_of(dep_id)
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -319,7 +368,11 @@ def _update_dependency(store: CommandStore, waiter: Command, dep: Command) -> No
         dep.remove_waiter(waiter.txn_id)
         changed = True
     if changed and wo.is_done():
-        maybe_execute(store, waiter)
+        # defer through the scheduler: a long chain of dependent commands
+        # resolving at once must not recurse (apply A -> notify B -> apply B
+        # -> ...); the reference gets this for free from per-store executors
+        store.node.scheduler.once(
+            0.0, lambda: maybe_execute(store, waiter))
 
 
 def set_durability(store: CommandStore, txn_id: TxnId, durability: Durability) -> None:

@@ -171,6 +171,68 @@ class CommandStore:
                         rb.add(r, rid)
         return Deps(kb.build(), rb.build())
 
+    # -- recovery scans ------------------------------------------------------
+    def recovery_info(self, txn_id: TxnId, seekables: Seekables):
+        """The three conflict scans a BeginRecovery answer needs (reference:
+        messages/BeginRecovery.java:329-380):
+
+          rejects_fast_path -- exists a conflicting txn that (a) started after
+            txn_id with a proposed/decided executeAt whose deps do not witness
+            txn_id, or (b) is stable, executes after txn_id, and does not
+            witness it: either proves txn_id CANNOT have fast-path committed.
+          earlier_committed_witness -- stable conflicts started before txn_id
+            whose deps DO witness it.
+          earlier_accepted_no_witness -- proposed conflicts started before
+            txn_id, executing after it, whose deps do NOT witness it (must
+            reach commit before recovery can safely propose the fast path).
+
+        Returns (rejects_fast_path, earlier_committed_witness: Deps,
+        earlier_accepted_no_witness: Deps)."""
+        rejects = False
+        ecw = KeyDepsBuilder()
+        eanw = KeyDepsBuilder()
+        tau = txn_id.as_timestamp()
+
+        def candidates_for_key(k):
+            c = self.cfks.get(k)
+            if c is not None:
+                yield from c._infos.keys()
+            for rid, rranges in self.range_txns.items():
+                if rranges.contains_key(k):
+                    yield rid
+
+        if isinstance(seekables, Keys):
+            owned_keys = self.owned_keys(seekables)
+        else:
+            owned_keys = Keys([k for k in self.cfks
+                               if seekables.slice(self.ranges).contains_key(k)])
+        for k in owned_keys:
+            for cand in candidates_for_key(k):
+                if cand == txn_id or not cand.kind.witnesses(txn_id.kind):
+                    continue
+                cmd = self.commands.get(cand)
+                if cmd is None or cmd.is_(Status.INVALIDATED) \
+                        or cmd.is_(Status.TRUNCATED):
+                    continue
+                if cmd.deps is None:
+                    continue  # no proposal/decision to inspect yet
+                has_proposal = cmd.status.has_been(Status.ACCEPTED)
+                is_stable = cmd.status.is_stable
+                witnesses_us = cmd.deps.contains_for(k, txn_id)
+                if cand > txn_id:
+                    if has_proposal and not witnesses_us:
+                        rejects = True
+                else:  # started before us
+                    if is_stable and witnesses_us:
+                        ecw.add(k, cand)
+                    elif has_proposal and not is_stable and not witnesses_us \
+                            and cmd.execute_at is not None and cmd.execute_at > tau:
+                        eanw.add(k, cand)
+                if is_stable and not witnesses_us \
+                        and cmd.execute_at is not None and cmd.execute_at > tau:
+                    rejects = True
+        return rejects, Deps(ecw.build()), Deps(eanw.build())
+
     # -- registration (feeds the conflict registry) -------------------------
     def register(self, txn_id: TxnId, seekables: Seekables, status: CfkStatus,
                  witnessed_at: Timestamp,

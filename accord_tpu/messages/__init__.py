@@ -4,6 +4,11 @@ from accord_tpu.messages.accept import Accept, AcceptOk, AcceptNack
 from accord_tpu.messages.commit import Commit, CommitOk
 from accord_tpu.messages.apply_msg import Apply, ApplyOk
 from accord_tpu.messages.read import ReadTxnData, ReadOk, ReadNack
+from accord_tpu.messages.recover import (
+    AcceptInvalidate, BeginRecovery, CheckStatus, CheckStatusOk,
+    CommitInvalidate, DepsEntry, DepsTier, InvalidateNack, InvalidateOk,
+    RecoverNack, RecoverOk, WaitOnCommit, WaitOnCommitOk,
+)
 
 __all__ = [
     "Request", "Reply", "Callback", "SimpleReply",
@@ -11,4 +16,8 @@ __all__ = [
     "Accept", "AcceptOk", "AcceptNack",
     "Commit", "CommitOk", "Apply", "ApplyOk",
     "ReadTxnData", "ReadOk", "ReadNack",
+    "BeginRecovery", "RecoverOk", "RecoverNack", "DepsEntry", "DepsTier",
+    "WaitOnCommit", "WaitOnCommitOk",
+    "AcceptInvalidate", "InvalidateOk", "InvalidateNack", "CommitInvalidate",
+    "CheckStatus", "CheckStatusOk",
 ]

@@ -14,18 +14,21 @@ from accord_tpu.primitives.timestamp import Ballot, Timestamp, TxnId
 
 class Accept(Request):
     def __init__(self, txn_id: TxnId, ballot: Ballot, route: Route,
-                 keys: Seekables, execute_at: Timestamp):
+                 keys: Seekables, execute_at: Timestamp,
+                 deps: Deps = Deps.NONE):
         self.txn_id = txn_id
         self.ballot = ballot
         self.route = route
         self.keys = keys
         self.execute_at = execute_at
+        self.deps = deps  # the coordinator's proposal; retained for recovery
         self.wait_for_epoch = max(txn_id.epoch, execute_at.epoch)
 
     def process(self, node, from_node, reply_context) -> None:
         def map_fn(store):
             outcome = commands.accept(store, self.txn_id, self.ballot, self.route,
-                                      store.owned(self.keys), self.execute_at)
+                                      store.owned(self.keys), self.execute_at,
+                                      self.deps)
             if outcome == AcceptOutcome.REJECTED_BALLOT:
                 return AcceptNack(self.txn_id, store.command(self.txn_id).promised)
             if outcome == AcceptOutcome.TRUNCATED:

@@ -1,0 +1,45 @@
+"""GetDeps: standalone dependency collection (reference:
+messages/GetDeps.java) -- ask a replica which witnessed conflicts started
+before a given bound. Used by recovery's CollectDeps when no committed deps
+cover a shard, and later by sync points."""
+from __future__ import annotations
+
+from accord_tpu.messages.base import Reply, Request
+from accord_tpu.primitives.deps import Deps
+from accord_tpu.primitives.keyspace import Seekables
+from accord_tpu.primitives.timestamp import Timestamp, TxnId
+
+
+class GetDeps(Request):
+    def __init__(self, txn_id: TxnId, keys: Seekables, before: Timestamp):
+        self.txn_id = txn_id
+        self.keys = keys
+        self.before = before
+        self.wait_for_epoch = txn_id.epoch
+
+    def process(self, node, from_node, reply_context) -> None:
+        def map_fn(store):
+            deps = store.calculate_deps(self.txn_id, store.owned(self.keys),
+                                        self.before)
+            return GetDepsOk(self.txn_id, deps)
+
+        def reduce_fn(a, b):
+            return GetDepsOk(self.txn_id, a.deps.union(b.deps))
+
+        node.command_stores.map_reduce(self.keys, map_fn, reduce_fn) \
+            .on_success(lambda reply: node.reply(from_node, reply_context, reply)) \
+            .on_failure(node.agent.on_uncaught_exception)
+
+    def __repr__(self):
+        return f"GetDeps({self.txn_id!r} before {self.before!r})"
+
+
+class GetDepsOk(Reply):
+    __slots__ = ("txn_id", "deps")
+
+    def __init__(self, txn_id: TxnId, deps: Deps):
+        self.txn_id = txn_id
+        self.deps = deps
+
+    def __repr__(self):
+        return f"GetDepsOk({self.txn_id!r})"

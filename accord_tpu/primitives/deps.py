@@ -334,6 +334,18 @@ class Deps:
         from accord_tpu.primitives.timestamp import Timestamp
         return Timestamp.merge_max(self.key_deps.max_txn_id(), self.range_deps.max_txn_id())
 
+    def contains_for(self, key: Key, txn_id: TxnId) -> bool:
+        """Is txn_id a dependency under this specific key? (the per-key
+        witness test recovery relies on -- reference TestDep WITH/WITHOUT)"""
+        return txn_id in self.key_deps.for_key(key) \
+            or txn_id in self.range_deps.for_key(key)
+
+    def participants_of(self, txn_id: TxnId) -> Optional[Keys]:
+        """Keys under which txn_id appears (reference: Deps.participants) --
+        where a probe/recovery for it must be addressed."""
+        keys = self.key_deps.participating_keys(txn_id)
+        return keys if not keys.is_empty() else None
+
     def union(self, other: "Deps") -> "Deps":
         return Deps(self.key_deps.union(other.key_deps),
                     self.range_deps.union(other.range_deps))

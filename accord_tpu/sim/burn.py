@@ -22,6 +22,7 @@ from accord_tpu.primitives.keyspace import Keys
 from accord_tpu.primitives.timestamp import Domain, TxnKind
 from accord_tpu.primitives.txn import Txn
 from accord_tpu.sim.cluster import Cluster, ClusterConfig
+from accord_tpu.sim.network import LinkConfig
 from accord_tpu.sim.list_store import ListQuery, ListRead, ListResult, ListUpdate
 from accord_tpu.sim.verifier import StrictSerializabilityVerifier
 from accord_tpu.utils.rng import RandomSource
@@ -44,11 +45,13 @@ class BurnReport:
 def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
              key_count: int = 32, concurrency: int = 8,
              write_ratio: float = 0.7, max_keys_per_txn: int = 3,
+             chaos_drop: float = 0.0, chaos_partitions: bool = False,
              config: Optional[ClusterConfig] = None,
              collect_log: bool = False) -> BurnReport:
     cfg = config or ClusterConfig(num_nodes=nodes, rf=rf)
     cluster = Cluster(seed, cfg)
     wl_rng = cluster.rng.fork()
+    chaos_rng = cluster.rng.fork()
     verifier = StrictSerializabilityVerifier()
     report = BurnReport()
     state = {"submitted": 0, "completed": 0, "next_value": 1}
@@ -99,6 +102,40 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
 
         node.coordinate(txn).add_callback(complete)
 
+    # chaos: periodically re-randomize link behavior (drops, partitions) the
+    # way the reference's burn test reshuffles Cluster.Link every 5s of sim
+    # time (reference test Cluster.java:458-462); heals once every op has
+    # completed so recovery can finish the stragglers before quiescence.
+    def heal():
+        net = cluster.network
+        net.partitioned.clear()
+        for a in cluster.nodes:
+            for b in cluster.nodes:
+                if a != b:
+                    net.set_link(a, b, LinkConfig())
+
+    def chaos_tick():
+        if state["completed"] >= ops:
+            heal()
+            return
+        net = cluster.network
+        net.partitioned.clear()
+        if chaos_partitions and chaos_rng.decide(0.4):
+            victim = 1 + chaos_rng.next_int(cfg.num_nodes)
+            for other in cluster.nodes:
+                if other != victim:
+                    net.set_partitioned(victim, other, True)
+        for a in cluster.nodes:
+            for b in cluster.nodes:
+                if a == b:
+                    continue
+                drop = chaos_rng.next_float() * chaos_drop
+                net.set_link(a, b, LinkConfig(drop_probability=drop))
+        cluster.queue.add(2_000_000, chaos_tick)
+
+    if chaos_drop > 0.0 or chaos_partitions:
+        cluster.queue.add(500_000, chaos_tick)
+
     # kick off with bounded concurrency
     for i in range(min(concurrency, ops)):
         cluster.queue.add(wl_rng.next_int(20_000), submit)
@@ -121,6 +158,10 @@ def main(argv=None) -> int:
     ap.add_argument("--rf", type=int, default=3)
     ap.add_argument("--keys", type=int, default=32)
     ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--chaos-drop", type=float, default=0.0,
+                    help="max per-link drop probability (re-randomized every 2s)")
+    ap.add_argument("--chaos-partitions", action="store_true",
+                    help="periodically partition a random node")
     ap.add_argument("--reconcile", action="store_true",
                     help="run each seed twice; require identical logs")
     args = ap.parse_args(argv)
@@ -128,7 +169,9 @@ def main(argv=None) -> int:
     ok = True
     for seed in range(args.seed, args.seed + args.count):
         kwargs = dict(ops=args.ops, nodes=args.nodes, rf=args.rf,
-                      key_count=args.keys, concurrency=args.concurrency)
+                      key_count=args.keys, concurrency=args.concurrency,
+                      chaos_drop=args.chaos_drop,
+                      chaos_partitions=args.chaos_partitions)
         try:
             r = run_burn(seed, collect_log=args.reconcile, **kwargs)
             if args.reconcile:

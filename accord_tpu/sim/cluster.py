@@ -25,7 +25,9 @@ from accord_tpu.utils.rng import RandomSource
 class ClusterConfig:
     def __init__(self, num_nodes: int = 3, rf: int = 3, num_shards: int = 4,
                  key_domain: int = 1 << 16, stores_per_node: int = 2,
-                 timeout_ms: float = 1000.0, deps_resolver_factory=None):
+                 timeout_ms: float = 1000.0, deps_resolver_factory=None,
+                 progress: bool = True, progress_interval_ms: float = 250.0,
+                 progress_stall_ms: float = 1500.0):
         self.num_nodes = num_nodes
         self.rf = min(rf, num_nodes)
         self.num_shards = num_shards
@@ -34,6 +36,9 @@ class ClusterConfig:
         self.timeout_ms = timeout_ms
         # factory() -> DepsResolver; None = host scan (the reference path)
         self.deps_resolver_factory = deps_resolver_factory
+        self.progress = progress  # enable the liveness/recovery engine
+        self.progress_interval_ms = progress_interval_ms
+        self.progress_stall_ms = progress_stall_ms
 
 
 def build_topology(cfg: ClusterConfig, epoch: int = 1) -> Topology:
@@ -95,8 +100,17 @@ class Cluster:
         self.failures: List = []
         self.nodes: Dict[NodeId, Node] = {}
         self.stores: Dict[NodeId, ListStore] = {}
+        self.progress_engines: Dict[NodeId, object] = {}
         for node_id in range(1, self.config.num_nodes + 1):
             store = ListStore()
+            progress_factory = None
+            engine = None
+            if self.config.progress:
+                from accord_tpu.impl.progress import ProgressEngine
+                engine = ProgressEngine(
+                    interval_ms=self.config.progress_interval_ms,
+                    stall_ms=self.config.progress_stall_ms)
+                progress_factory = engine.log_for
             node = Node(
                 node_id,
                 message_sink=self.network.sink_for(node_id),
@@ -107,9 +121,13 @@ class Cluster:
                 time_service=self.time_service,
                 data_store=store,
                 num_stores=self.config.stores_per_node,
+                progress_log_factory=progress_factory,
                 deps_resolver=(self.config.deps_resolver_factory()
                                if self.config.deps_resolver_factory else None),
             )
+            if engine is not None:
+                engine.bind(node)
+                self.progress_engines[node_id] = engine
             self.nodes[node_id] = node
             self.stores[node_id] = store
             self.network.register_node(node)

@@ -14,7 +14,7 @@ from accord_tpu.primitives.writes import Writes
 
 def setup_store():
     cluster = Cluster(1, ClusterConfig(num_nodes=1, rf=1, num_shards=1,
-                                       stores_per_node=1))
+                                       stores_per_node=1, progress=False))
     node = cluster.nodes[1]
     return cluster, node, node.command_stores.stores[0]
 
@@ -107,6 +107,7 @@ def test_execution_waits_for_deps():
     commands.apply(store, t1, route1, txn1.slice(store.ranges, False),
                    t1.as_timestamp(), Deps.NONE, w1, None)
     assert store.command(t1).status == Status.APPLIED
+    cluster.drain()  # unblocked executions are deferred through the scheduler
     assert cmd2.status == Status.READY_TO_EXECUTE
     assert node.data_store.snapshot(5) == (1,)
 
@@ -135,6 +136,7 @@ def test_invalidated_dep_is_dropped():
     cmd2 = store.command(t2)
     assert t1 in cmd2.waiting_on.commit
     commands.commit_invalidate(store, t1)
+    cluster.drain()  # unblocked executions are deferred through the scheduler
     assert cmd2.status == Status.READY_TO_EXECUTE
 
 

@@ -1,0 +1,207 @@
+"""Recovery-path tests: BeginRecovery decisions, invalidation, propagation,
+and the chaos burn (drops + partitions) staying strict-serializable.
+
+Mirrors the reference's RecoverTest / burn-with-faults strategy
+(SURVEY.md section 4): drive specific partial protocol states through the
+simulated network, then let recovery finish or kill the transaction, and
+check cluster convergence.
+"""
+import pytest
+
+from accord_tpu.coordinate.recover import MaybeRecover, Outcome, Recover
+from accord_tpu.coordinate.errors import Preempted
+from accord_tpu.local.status import Status
+from accord_tpu.messages import BeginRecovery, PreAccept, Accept, AcceptOk
+from accord_tpu.messages.base import Callback
+from accord_tpu.primitives.keyspace import Keys
+from accord_tpu.primitives.timestamp import Ballot, TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.sim.burn import run_burn
+from accord_tpu.sim.cluster import Cluster, ClusterConfig
+from accord_tpu.sim.list_store import ListQuery, ListRead, ListUpdate
+
+
+class _Sink(Callback):
+    def __init__(self):
+        self.replies = []
+        self.failures = []
+
+    def on_success(self, from_node, reply):
+        self.replies.append((from_node, reply))
+
+    def on_failure(self, from_node, failure):
+        self.failures.append((from_node, failure))
+
+
+def _write_txn(keys, value):
+    return Txn(TxnKind.WRITE, keys, read=ListRead(keys),
+               update=ListUpdate(keys, value), query=ListQuery())
+
+
+def _cluster(seed=7):
+    return Cluster(seed, ClusterConfig(num_nodes=3, rf=3, progress=False))
+
+
+def _outcome(result):
+    assert result.done, "recovery did not complete"
+    if result.failure is not None:
+        raise result.failure
+    return result.value()
+
+
+def test_recover_preaccepted_completes_fast_path():
+    """A txn witnessed everywhere but abandoned pre-Accept: recovery re-proposes
+    executeAt=txnId and executes it to completion."""
+    cl = _cluster()
+    n1 = cl.node(1)
+    keys = Keys([100, 40000])
+    txn = _write_txn(keys, 1)
+    txn_id = n1.next_txn_id(txn.kind, txn.domain)
+    route = n1.compute_route(txn)
+
+    sink = _Sink()
+    for to in (1, 2, 3):
+        n1.send(to, PreAccept(txn_id, txn, route), sink)
+    cl.drain()
+    assert len(sink.replies) == 3
+
+    result = Recover.recover(cl.node(2), txn_id, txn, route)
+    cl.drain()
+    assert _outcome(result) == Outcome.APPLIED
+    for nid in (1, 2, 3):
+        assert cl.stores[nid].data[100] == [*cl.stores[nid].data[100][:0],
+                                            cl.stores[nid].data[100][0]]
+        assert [v for _, v in cl.stores[nid].data[100]] == [1]
+        assert [v for _, v in cl.stores[nid].data[40000]] == [1]
+
+
+def test_recover_unwitnessed_invalidates():
+    """A txn no replica ever saw gets raced to invalidation, and later
+    preaccepts for it are refused."""
+    cl = _cluster()
+    n1 = cl.node(1)
+    keys = Keys([123])
+    txn = _write_txn(keys, 9)
+    txn_id = n1.next_txn_id(txn.kind, txn.domain)
+    route = n1.compute_route(txn)
+
+    result = MaybeRecover.probe(cl.node(3), txn_id, keys)
+    cl.drain()
+    assert _outcome(result) == Outcome.INVALIDATED
+
+    # the original coordinator's late PreAccept must not resurrect it
+    sink = _Sink()
+    for to in (1, 2, 3):
+        n1.send(to, PreAccept(txn_id, txn, route), sink)
+    cl.drain()
+    assert all([v for _, v in s.data.get(123, [])] == []
+               for s in cl.stores.values())
+
+
+def test_recover_accepted_resumes_proposal():
+    """A txn that reached Accept on a quorum resumes from the accepted
+    (executeAt, deps) and completes."""
+    cl = _cluster()
+    n1 = cl.node(1)
+    keys = Keys([555])
+    txn = _write_txn(keys, 5)
+    txn_id = n1.next_txn_id(txn.kind, txn.domain)
+    route = n1.compute_route(txn)
+
+    pre = _Sink()
+    for to in (1, 2, 3):
+        n1.send(to, PreAccept(txn_id, txn, route), pre)
+    cl.drain()
+    execute_at = max(r.witnessed_at for _, r in pre.replies)
+    deps = pre.replies[0][1].deps
+    acc = _Sink()
+    for to in (1, 2):  # quorum only
+        n1.send(to, Accept(txn_id, Ballot.ZERO, route, keys, execute_at, deps), acc)
+    cl.drain()
+    assert sum(isinstance(r, AcceptOk) for _, r in acc.replies) == 2
+
+    result = Recover.recover(cl.node(3), txn_id, txn, route)
+    cl.drain()
+    assert _outcome(result) == Outcome.APPLIED
+    for nid in (1, 2, 3):
+        assert [v for _, v in cl.stores[nid].data[555]] == [5]
+
+
+def test_recover_applied_txn_propagates():
+    """A fully-applied txn being probed just propagates APPLIED."""
+    cl = _cluster()
+    n1 = cl.node(1)
+    keys = Keys([777])
+    txn = _write_txn(keys, 3)
+    res = n1.coordinate(txn)
+    cl.drain()
+    assert res.done and res.failure is None
+
+    # any txn id the cluster knows: find it on node 2
+    store = next(s for s in cl.node(2).command_stores.all()
+                 if s.owns(keys))
+    txn_id = next(iter(store.commands))
+    probe = MaybeRecover.probe(cl.node(3), txn_id, keys)
+    cl.drain()
+    assert _outcome(probe) == Outcome.APPLIED
+
+
+def test_recover_preempted_by_higher_ballot():
+    cl = _cluster()
+    n1 = cl.node(1)
+    keys = Keys([888])
+    txn = _write_txn(keys, 8)
+    txn_id = n1.next_txn_id(txn.kind, txn.domain)
+    route = n1.compute_route(txn)
+
+    high = Ballot(1, 1 << 40, 0, 3)
+    sink = _Sink()
+    for to in (1, 2, 3):
+        n1.send(to, BeginRecovery(txn_id, txn, route, high), sink)
+    cl.drain()
+
+    low = Ballot(1, 1, 0, 2)
+    result = Recover.recover(cl.node(2), txn_id, txn, route, ballot=low)
+    cl.drain()
+    assert result.done and isinstance(result.failure, Preempted)
+
+
+def test_invalidated_stays_dead_under_late_accept():
+    """After invalidation commits, a late Accept round must not succeed."""
+    cl = _cluster()
+    n1 = cl.node(1)
+    keys = Keys([999])
+    txn = _write_txn(keys, 4)
+    txn_id = n1.next_txn_id(txn.kind, txn.domain)
+    route = n1.compute_route(txn)
+
+    probe = MaybeRecover.probe(cl.node(2), txn_id, keys)
+    cl.drain()
+    assert _outcome(probe) == Outcome.INVALIDATED
+
+    acc = _Sink()
+    ea = txn_id.as_timestamp()
+    for to in (1, 2, 3):
+        n1.send(to, Accept(txn_id, Ballot.ZERO, route, keys, ea), acc)
+    cl.drain()
+    oks = [r for _, r in acc.replies if isinstance(r, AcceptOk)]
+    assert len(oks) == 0
+
+
+@pytest.mark.parametrize("seed", [11, 12])
+def test_burn_with_drops(seed):
+    r = run_burn(seed, ops=200, chaos_drop=0.04)
+    assert r.lost == 0
+
+
+def test_burn_with_partitions():
+    r = run_burn(21, ops=200, chaos_drop=0.05, chaos_partitions=True)
+    assert r.lost == 0
+
+
+def test_burn_chaos_deterministic():
+    a = run_burn(31, ops=120, chaos_drop=0.05, chaos_partitions=True,
+                 collect_log=True)
+    b = run_burn(31, ops=120, chaos_drop=0.05, chaos_partitions=True,
+                 collect_log=True)
+    assert a.log == b.log
