@@ -28,7 +28,7 @@ from typing import Dict, List, Optional, Tuple
 
 from accord_tpu.coordinate.errors import Exhausted, Invalidated, Preempted, Timeout
 from accord_tpu.coordinate.tracking import QuorumTracker, RecoveryTracker, RequestStatus
-from accord_tpu.local.status import Status
+from accord_tpu.local.status import Status, recovery_rank
 from accord_tpu.messages.base import Callback
 from accord_tpu.messages.recover import (
     AcceptInvalidate, BeginRecovery, CheckStatus, CheckStatusOk, CommitInvalidate,
@@ -48,7 +48,7 @@ class Outcome(enum.Enum):
     """What recovery concluded (reference: ProgressToken)."""
     APPLIED = "applied"
     INVALIDATED = "invalidated"
-    TRUNCATED = "truncated"
+    TRUNCATED = "truncated"  # produced once durability rounds + truncation land
 
 
 class Recover(Callback):
@@ -103,12 +103,14 @@ class Recover(Callback):
     def _recover(self) -> None:
         self._decided = True
         oks = list(self.oks.values())
-        best = max(oks, key=lambda ok: (ok.status, ok.accepted_ballot))
+        best = max(oks, key=lambda ok: recovery_rank(ok.status, ok.accepted_ballot))
         status = best.status
+        # NOTE: a truncated store currently surfaces as RecoverNack (never as
+        # a RecoverOk in self.oks); truncation implies the outcome was durable
+        # on a majority, so once durability rounds land the truncated case is
+        # resolved via CheckStatus/Outcome propagation rather than by re-running
+        # the accept-phase reasoning over stale surviving knowledge.
 
-        if status == Status.TRUNCATED:
-            self.result.try_set_success(Outcome.TRUNCATED)
-            return
         if status == Status.INVALIDATED:
             self._commit_invalidate()
             return
@@ -368,22 +370,70 @@ def propose_invalidate(node, txn_id: TxnId, ballot: Ballot, key,
     Propose.Invalidate.proposeInvalidate): that shard's quorum participates in
     any commit of txn_id, so a promised invalidation there blocks them all.
 
-    With abort_if_witnessed (the blind Invalidate path, where nothing proves
-    the fast path impossible), ANY witness aborts with WitnessedElsewhere:
-    the txn's coordinator may still be concurrently fast-committing, and only
-    a full BeginRecovery round can reason about that safely."""
+    The accept is only safe once `ballot` has been PREPARED on a quorum of
+    the shard. With abort_if_witnessed (the blind Invalidate path, where no
+    BeginRecovery round preceded us) we run that prepare here as a
+    BeginInvalidation round: replicas promise the ballot *without* mutating
+    status, and ANY witness aborts with WitnessedElsewhere before a single
+    ACCEPTED_INVALIDATE is written — the txn's coordinator may still be
+    concurrently committing its proposal, and only a full BeginRecovery round
+    can reason about that safely. Without abort_if_witnessed the caller is
+    the recovery coordinator, whose BeginRecovery quorum at this same ballot
+    already served as the prepare."""
+    from accord_tpu.messages.recover import BeginInvalidation
     topology = node.topology_manager.for_epoch(txn_id.epoch)
     shard = topology.shard_for_key(key)
-    tracker = QuorumTracker(
+    result = AsyncResult()
+
+    def accept_round() -> None:
+        tracker = QuorumTracker(
+            node.topology_manager.with_unsynced_epochs(
+                Route(key, Keys([key])), txn_id.epoch, txn_id.epoch),
+            Keys([key]))
+
+        class AcceptCb(Callback):
+            def on_success(self, from_node, reply) -> None:
+                if result.done:
+                    return
+                if isinstance(reply, InvalidateNack):
+                    result.try_set_failure(Preempted(
+                        f"invalidate {txn_id} superseded by {reply.promised}"))
+                    return
+                if tracker.on_success(from_node) == RequestStatus.SUCCESS:
+                    result.try_set_success(None)
+
+            def on_failure(self, from_node, failure) -> None:
+                if tracker.on_failure(from_node) == RequestStatus.FAILED:
+                    result.try_set_failure(Timeout(f"invalidate {txn_id}"))
+
+        cb = AcceptCb()
+        for to in shard.nodes:
+            node.send(to, AcceptInvalidate(txn_id, ballot, key), cb)
+
+    if not abort_if_witnessed:
+        accept_round()
+        return result
+
+    prepare_tracker = QuorumTracker(
         node.topology_manager.with_unsynced_epochs(
             Route(key, Keys([key])), txn_id.epoch, txn_id.epoch),
         Keys([key]))
-    result = AsyncResult()
 
-    class Cb(Callback):
+    class PrepareCb(Callback):
+        # Invalidation is a NEGATIVE decision: like MaybeRecover, wait for
+        # every reachable reply before acting, because (a) a bare quorum can
+        # simply have missed the one witness a straggler would report, and
+        # (b) dispatching the accept round while prepare replies are still in
+        # flight races a late WitnessedElsewhere abort against an accepted
+        # invalidation quorum — the caller would be told "recover instead"
+        # after we wrote the very state that makes recovery finish the kill.
+        answered = 0
+        quorum = False
+
         def on_success(self, from_node, reply) -> None:
             if result.done:
                 return
+            self.answered += 1
             if isinstance(reply, InvalidateNack):
                 result.try_set_failure(Preempted(
                     f"invalidate {txn_id} superseded by {reply.promised}"))
@@ -393,22 +443,32 @@ def propose_invalidate(node, txn_id: TxnId, ballot: Ballot, key,
                 result.try_set_failure(Preempted(
                     f"invalidate {txn_id}: already decided ({reply.status.name})"))
                 return
-            if abort_if_witnessed and reply.status.has_been(Status.PRE_ACCEPTED) \
+            if reply.status.has_been(Status.PRE_ACCEPTED) \
                     and reply.status != Status.ACCEPTED_INVALIDATE \
                     and not reply.status.is_terminal:
                 result.try_set_failure(
                     WitnessedElsewhere(txn_id, reply.status, reply.route))
                 return
-            if tracker.on_success(from_node) == RequestStatus.SUCCESS:
-                result.try_set_success(None)
+            if prepare_tracker.on_success(from_node) == RequestStatus.SUCCESS:
+                self.quorum = True
+            self._maybe_dispatch()
 
         def on_failure(self, from_node, failure) -> None:
-            if tracker.on_failure(from_node) == RequestStatus.FAILED:
+            if result.done:
+                return
+            self.answered += 1
+            if prepare_tracker.on_failure(from_node) == RequestStatus.FAILED:
                 result.try_set_failure(Timeout(f"invalidate {txn_id}"))
+                return
+            self._maybe_dispatch()
 
-    cb = Cb()
+        def _maybe_dispatch(self) -> None:
+            if self.answered >= len(shard.nodes) and self.quorum:
+                accept_round()
+
+    prep = PrepareCb()
     for to in shard.nodes:
-        node.send(to, AcceptInvalidate(txn_id, ballot, key), cb)
+        node.send(to, BeginInvalidation(txn_id, ballot, key), prep)
     return result
 
 

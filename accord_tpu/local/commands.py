@@ -139,11 +139,17 @@ def accept_invalidate(store: CommandStore, txn_id: TxnId, ballot: Ballot) -> Acc
         return AcceptOutcome.REDUNDANT
     if cmd.promised > ballot:
         return AcceptOutcome.REJECTED_BALLOT
-    if cmd.has_been(Status.COMMITTED):
+    if cmd.status.is_decided:
+        # executeAt is decided (PRE_COMMITTED or beyond): too late to
+        # invalidate (reference gates on hasBeen(PreCommitted))
         return AcceptOutcome.REDUNDANT
     cmd.promised = ballot
     cmd.accepted_ballot = ballot
-    cmd.status = max(cmd.status, Status.ACCEPTED_INVALIDATE)
+    # supersedes even an ACCEPTED proposal: the higher ballot wins the Accept
+    # phase, and leaving the status at ACCEPTED would hide this replica's
+    # accepted invalidation from recovery (reference: Commands.acceptInvalidate
+    # sets SaveStatus.AcceptedInvalidate over Accepted)
+    cmd.status = Status.ACCEPTED_INVALIDATE
     notify_listeners(store, cmd)
     return AcceptOutcome.SUCCESS
 

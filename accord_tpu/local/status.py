@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import enum
 
+from accord_tpu.primitives.timestamp import Ballot
+
 
 class Phase(enum.IntEnum):
     NONE = 0
@@ -83,6 +85,19 @@ _PHASES = {
     Status.INVALIDATED: Phase.CLEANUP,
     Status.TRUNCATED: Phase.CLEANUP,
 }
+
+
+def recovery_rank(status: Status, ballot) -> tuple:
+    """Sort key for recovery-reply comparison, mirroring the reference's
+    Status.max tie-break rules (local/Status.java Phase.tieBreakWithBallot):
+    compare phase first; within the Accept phase the BALLOT decides (an
+    AcceptedInvalidate at a higher ballot supersedes an Accepted at a lower
+    one — ranking by raw status ordinal would resurrect a txn whose
+    invalidation a later recovery already accepted); otherwise status ordinal
+    decides, with ballot as the final tie-break."""
+    phase = status.phase
+    tiebreak = ballot if phase == Phase.ACCEPT else Ballot.ZERO
+    return (phase, tiebreak, status, ballot)
 
 
 class Durability(enum.IntEnum):

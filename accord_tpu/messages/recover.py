@@ -16,7 +16,7 @@ from typing import List, Optional, Tuple
 from accord_tpu.local import commands
 from accord_tpu.local.command import TransientListener
 from accord_tpu.local.commands import AcceptOutcome
-from accord_tpu.local.status import Status
+from accord_tpu.local.status import Status, recovery_rank
 from accord_tpu.messages.base import Reply, Request
 from accord_tpu.primitives.deps import Deps
 from accord_tpu.primitives.keyspace import Ranges, Seekables
@@ -97,9 +97,12 @@ class BeginRecovery(Request):
         def reduce_fn(a, b):
             if isinstance(a, RecoverNack) or isinstance(b, RecoverNack):
                 return a if isinstance(a, RecoverNack) else b
-            # keep the decision of the most advanced store; witnessed
+            # keep the decision of the most advanced store (phase, then ballot
+            # within the Accept phase: an accepted invalidation at a higher
+            # ballot must surface over a stale acceptance); witnessed
             # timestamps max-merge while still undecided
-            hi, lo = (a, b) if (a.status, a.accepted_ballot) >= (b.status, b.accepted_ballot) else (b, a)
+            hi, lo = (a, b) if recovery_rank(a.status, a.accepted_ballot) \
+                >= recovery_rank(b.status, b.accepted_ballot) else (b, a)
             execute_at = hi.execute_at
             if hi.status == Status.PRE_ACCEPTED and lo.execute_at is not None:
                 execute_at = max(execute_at, lo.execute_at)
@@ -236,10 +239,56 @@ class WaitOnCommitOk(Reply):
 # Invalidation (reference: messages/BeginInvalidation.java + Commit.Invalidate)
 # ---------------------------------------------------------------------------
 
+class BeginInvalidation(Request):
+    """PREPARE phase of a blind invalidation (reference:
+    messages/BeginInvalidation.java): promise `ballot` on the arbitration
+    shard's replicas and report what each has witnessed — WITHOUT mutating
+    command status. The coordinator only proceeds to AcceptInvalidate once a
+    quorum of clean promises proves no replica witnessed the txn; mutating at
+    prepare time would leave stray ACCEPTED_INVALIDATE state on replicas when
+    the coordinator aborts with WitnessedElsewhere, which a later recovery
+    could mistake for a chosen invalidation."""
+
+    def __init__(self, txn_id: TxnId, ballot: Ballot, key):
+        self.txn_id = txn_id
+        self.ballot = ballot
+        self.key = key
+        self.wait_for_epoch = txn_id.epoch
+
+    def process(self, node, from_node, reply_context) -> None:
+        from accord_tpu.primitives.keyspace import Keys
+        keys = Keys([self.key])
+
+        def map_fn(store):
+            cmd = store.command(self.txn_id)
+            if not cmd.status.is_terminal:
+                if cmd.promised > self.ballot:
+                    return InvalidateNack(self.txn_id, cmd.promised, cmd.route)
+                cmd.promised = self.ballot
+            return InvalidateOk(self.txn_id, cmd.status, cmd.route)
+
+        def reduce_fn(a, b):
+            if isinstance(a, InvalidateNack) or isinstance(b, InvalidateNack):
+                return a if isinstance(a, InvalidateNack) else b
+            return a if a.status >= b.status else b
+
+        node.command_stores.map_reduce(keys, map_fn, reduce_fn) \
+            .on_success(lambda reply: node.reply(from_node, reply_context, reply)) \
+            .on_failure(node.agent.on_uncaught_exception)
+
+    def __repr__(self):
+        return f"BeginInvalidation({self.txn_id!r}, ballot={self.ballot!r})"
+
+
 class AcceptInvalidate(Request):
     """Ballot-accept a proposal to invalidate txn_id, addressed to the
     replicas of ONE shard (any shard of the txn suffices: every commit needs
-    that shard's quorum, so a promised invalidation quorum blocks commits)."""
+    that shard's quorum, so a promised invalidation quorum blocks commits).
+
+    Safety precondition: the sender's ballot was PREPARED on a quorum of this
+    shard — by BeginInvalidation (blind path) or BeginRecovery (recovery
+    path) — so accepting it cannot conflict with a chosen lower-ballot
+    proposal."""
 
     def __init__(self, txn_id: TxnId, ballot: Ballot, key):
         self.txn_id = txn_id
@@ -378,7 +427,8 @@ class CheckStatusOk(Reply):
 
     @staticmethod
     def merge(a: "CheckStatusOk", b: "CheckStatusOk") -> "CheckStatusOk":
-        hi, lo = (a, b) if (a.status, a.accepted_ballot) >= (b.status, b.accepted_ballot) else (b, a)
+        hi, lo = (a, b) if recovery_rank(a.status, a.accepted_ballot) \
+            >= recovery_rank(b.status, b.accepted_ballot) else (b, a)
         txn = hi.partial_txn
         if txn is None:
             txn = lo.partial_txn

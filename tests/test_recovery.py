@@ -188,6 +188,148 @@ def test_invalidated_stays_dead_under_late_accept():
     assert len(oks) == 0
 
 
+def test_recovery_rank_accept_phase_tiebreaks_by_ballot():
+    """The Accept phase ranks by ballot first: ACCEPTED_INVALIDATE at a higher
+    ballot must outrank ACCEPTED at Ballot.ZERO (ADVICE r1: ranking by raw
+    status ordinal resurrects invalidated txns)."""
+    from accord_tpu.local.status import recovery_rank
+    from accord_tpu.primitives.timestamp import Ballot as B
+    hi = B(1, 50, 0, 2)
+    assert recovery_rank(Status.ACCEPTED_INVALIDATE, hi) \
+        > recovery_rank(Status.ACCEPTED, B.ZERO)
+    # same ballot: status ordinal decides within the phase
+    assert recovery_rank(Status.ACCEPTED, B.ZERO) \
+        > recovery_rank(Status.ACCEPTED_INVALIDATE, B.ZERO)
+    # a decided status beats any accept-phase ballot
+    assert recovery_rank(Status.COMMITTED, B.ZERO) \
+        > recovery_rank(Status.ACCEPTED_INVALIDATE, hi)
+    # pre-accept never outranks accept
+    assert recovery_rank(Status.PRE_ACCEPTED, hi) \
+        < recovery_rank(Status.ACCEPTED_INVALIDATE, B.ZERO)
+
+
+def test_recover_honours_higher_ballot_accepted_invalidate():
+    """Quorum holds ACCEPTED@Ballot.ZERO on one replica and
+    ACCEPTED_INVALIDATE@higher on another: recovery must finish the
+    invalidation, not re-propose and apply (split decision)."""
+    from accord_tpu.messages.recover import AcceptInvalidate
+    cl = _cluster()
+    n1 = cl.node(1)
+    keys = Keys([777])
+    txn = _write_txn(keys, 6)
+    txn_id = n1.next_txn_id(txn.kind, txn.domain)
+    route = n1.compute_route(txn)
+
+    pre = _Sink()
+    for to in (1, 2, 3):
+        n1.send(to, PreAccept(txn_id, txn, route), pre)
+    cl.drain()
+    execute_at = max(r.witnessed_at for _, r in pre.replies)
+    deps = pre.replies[0][1].deps
+    acc = _Sink()
+    n1.send(1, Accept(txn_id, Ballot.ZERO, route, keys, execute_at, deps), acc)
+    cl.drain()
+    assert sum(isinstance(r, AcceptOk) for _, r in acc.replies) == 1
+
+    # a prior recovery proposed invalidation at a higher ballot on 2,3
+    b1 = Ballot.from_timestamp(n1.unique_now())
+    inv = _Sink()
+    for to in (2, 3):
+        n1.send(to, AcceptInvalidate(txn_id, b1, route.home_key), inv)
+    cl.drain()
+    assert len(inv.replies) == 2 and not inv.failures
+
+    result = Recover.recover(cl.node(2), txn_id, txn, route)
+    cl.drain()
+    assert _outcome(result) == Outcome.INVALIDATED
+    for nid in (1, 2, 3):
+        assert 777 not in cl.stores[nid].data \
+            or [v for _, v in cl.stores[nid].data[777]] == []
+
+
+def test_multi_store_replica_surfaces_accepted_invalidate():
+    """ADVICE r1 #2: a replica whose stores hold ACCEPTED@ZERO (one key's
+    store) and ACCEPTED_INVALIDATE@higher (the arbitration key's store) must
+    report ACCEPTED_INVALIDATE from BeginRecovery, not mask it."""
+    from accord_tpu.messages.recover import AcceptInvalidate, RecoverOk
+    cl = _cluster()
+    n1 = cl.node(1)
+    keys = Keys([100, 40000])  # land in different command stores
+    txn = _write_txn(keys, 7)
+    txn_id = n1.next_txn_id(txn.kind, txn.domain)
+    route = n1.compute_route(txn)
+
+    pre = _Sink()
+    for to in (1, 2, 3):
+        n1.send(to, PreAccept(txn_id, txn, route), pre)
+    cl.drain()
+    execute_at = max(r.witnessed_at for _, r in pre.replies)
+    deps = pre.replies[0][1].deps
+    acc = _Sink()
+    n1.send(1, Accept(txn_id, Ballot.ZERO, route, keys, execute_at, deps), acc)
+    cl.drain()
+
+    b1 = Ballot.from_timestamp(n1.unique_now())
+    inv = _Sink()
+    n1.send(1, AcceptInvalidate(txn_id, b1, route.home_key), inv)
+    cl.drain()
+    assert len(inv.replies) == 1 and not inv.failures
+
+    b2 = Ballot.from_timestamp(n1.unique_now())
+    rec = _Sink()
+    n1.send(1, BeginRecovery(txn_id, txn, route, b2), rec)
+    cl.drain()
+    assert len(rec.replies) == 1
+    reply = rec.replies[0][1]
+    assert isinstance(reply, RecoverOk)
+    assert reply.status == Status.ACCEPTED_INVALIDATE
+    assert reply.accepted_ballot == b1
+
+
+def test_blind_invalidate_prepare_leaves_no_stray_accept():
+    """The blind-invalidate path must abort on a witness WITHOUT mutating any
+    replica's status: a stray ACCEPTED_INVALIDATE left behind by an aborted
+    invalidation would outrank the quorum-chosen ACCEPTED@ZERO proposal in a
+    later recovery (code-review r2 finding 1)."""
+    from accord_tpu.coordinate.recover import propose_invalidate, WitnessedElsewhere
+    cl = _cluster()
+    n1 = cl.node(1)
+    keys = Keys([888])
+    txn = _write_txn(keys, 8)
+    txn_id = n1.next_txn_id(txn.kind, txn.domain)
+    route = n1.compute_route(txn)
+
+    pre = _Sink()
+    for to in (1, 2, 3):
+        n1.send(to, PreAccept(txn_id, txn, route), pre)
+    cl.drain()
+    execute_at = max(r.witnessed_at for _, r in pre.replies)
+    deps = pre.replies[0][1].deps
+    acc = _Sink()
+    for to in (1, 2):
+        n1.send(to, Accept(txn_id, Ballot.ZERO, route, keys, execute_at, deps), acc)
+    cl.drain()
+    assert sum(isinstance(r, AcceptOk) for _, r in acc.replies) == 2
+
+    b1 = Ballot.from_timestamp(cl.node(3).unique_now())
+    result = propose_invalidate(cl.node(3), txn_id, b1, route.home_key,
+                                abort_if_witnessed=True)
+    cl.drain()
+    assert result.done and isinstance(result.failure, WitnessedElsewhere)
+    # no replica's status was demoted by the aborted prepare
+    for nid in (1, 2, 3):
+        for store in cl.node(nid).command_stores.all():
+            cmd = store.command_if_present(txn_id)
+            if cmd is not None:
+                assert cmd.status != Status.ACCEPTED_INVALIDATE
+    # and the txn still recovers to its chosen proposal
+    rec = Recover.recover(cl.node(3), txn_id, txn, route)
+    cl.drain()
+    assert _outcome(rec) == Outcome.APPLIED
+    for nid in (1, 2, 3):
+        assert [v for _, v in cl.stores[nid].data[888]] == [8]
+
+
 @pytest.mark.parametrize("seed", [11, 12])
 def test_burn_with_drops(seed):
     r = run_burn(seed, ops=200, chaos_drop=0.04)
