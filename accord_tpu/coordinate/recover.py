@@ -31,9 +31,9 @@ from accord_tpu.coordinate.tracking import QuorumTracker, RecoveryTracker, Reque
 from accord_tpu.local.status import Status, recovery_rank
 from accord_tpu.messages.base import Callback
 from accord_tpu.messages.recover import (
-    AcceptInvalidate, BeginRecovery, CheckStatus, CheckStatusOk, CommitInvalidate,
-    DepsTier, InvalidateNack, InvalidateOk, RecoverNack, RecoverOk,
-    WaitOnCommit, WaitOnCommitOk,
+    AcceptInvalidate, BeginInvalidation, BeginRecovery, CheckStatus,
+    CheckStatusOk, CommitInvalidate, DepsTier, InvalidateNack, InvalidateOk,
+    RecoverNack, RecoverOk, WaitOnCommit, WaitOnCommitOk,
 )
 from accord_tpu.primitives.deps import Deps
 from accord_tpu.primitives.keyspace import Keys, Ranges, Seekables
@@ -380,16 +380,18 @@ def propose_invalidate(node, txn_id: TxnId, ballot: Ballot, key,
     can reason about that safely. Without abort_if_witnessed the caller is
     the recovery coordinator, whose BeginRecovery quorum at this same ballot
     already served as the prepare."""
-    from accord_tpu.messages.recover import BeginInvalidation
     topology = node.topology_manager.for_epoch(txn_id.epoch)
     shard = topology.shard_for_key(key)
     result = AsyncResult()
 
-    def accept_round() -> None:
-        tracker = QuorumTracker(
+    def make_tracker() -> QuorumTracker:
+        return QuorumTracker(
             node.topology_manager.with_unsynced_epochs(
                 Route(key, Keys([key])), txn_id.epoch, txn_id.epoch),
             Keys([key]))
+
+    def accept_round() -> None:
+        tracker = make_tracker()
 
         class AcceptCb(Callback):
             def on_success(self, from_node, reply) -> None:
@@ -414,10 +416,7 @@ def propose_invalidate(node, txn_id: TxnId, ballot: Ballot, key,
         accept_round()
         return result
 
-    prepare_tracker = QuorumTracker(
-        node.topology_manager.with_unsynced_epochs(
-            Route(key, Keys([key])), txn_id.epoch, txn_id.epoch),
-        Keys([key]))
+    prepare_tracker = make_tracker()
 
     class PrepareCb(Callback):
         # Invalidation is a NEGATIVE decision: like MaybeRecover, wait for

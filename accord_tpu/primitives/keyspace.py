@@ -124,6 +124,9 @@ class Range:
     def __setattr__(self, *a):
         raise AttributeError("immutable")
 
+    def __reduce__(self):
+        return (Range, (self.start, self.end))
+
     @classmethod
     def point(cls, key: Key) -> "Range":
         return cls(key, _Successor(key))

@@ -118,6 +118,12 @@ class Timestamp:
     def __setattr__(self, *a):
         raise AttributeError("immutable")
 
+    def __reduce__(self):
+        # explicit reduce: the immutable __setattr__ breaks default
+        # slot-state pickling, and the wire boundary (sim/wire.py) pickles
+        # every message
+        return (type(self), (self.epoch, self.hlc, self.flags, self.node))
+
     # -- ordering ------------------------------------------------------------
     def _key(self) -> Tuple[int, int, int, int]:
         return (self.epoch, self.hlc, self.flags, self.node)

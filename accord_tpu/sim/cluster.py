@@ -27,7 +27,7 @@ class ClusterConfig:
                  key_domain: int = 1 << 16, stores_per_node: int = 2,
                  timeout_ms: float = 1000.0, deps_resolver_factory=None,
                  progress: bool = True, progress_interval_ms: float = 250.0,
-                 progress_stall_ms: float = 1500.0):
+                 progress_stall_ms: float = 1500.0, serialize: bool = True):
         self.num_nodes = num_nodes
         self.rf = min(rf, num_nodes)
         self.num_shards = num_shards
@@ -39,6 +39,7 @@ class ClusterConfig:
         self.progress = progress  # enable the liveness/recovery engine
         self.progress_interval_ms = progress_interval_ms
         self.progress_stall_ms = progress_stall_ms
+        self.serialize = serialize  # wire-codec round-trip for every message
 
 
 def build_topology(cfg: ClusterConfig, epoch: int = 1) -> Topology:
@@ -93,7 +94,8 @@ class Cluster:
         self.rng = RandomSource(seed)
         self.queue = PendingQueue()
         self.network = SimNetwork(self.queue, self.rng.fork(),
-                                  timeout_ms=self.config.timeout_ms)
+                                  timeout_ms=self.config.timeout_ms,
+                                  serialize=self.config.serialize)
         self.scheduler = SimScheduler(self.queue)
         self.time_service = SimTimeService(self.queue)
         self.topology = build_topology(self.config)

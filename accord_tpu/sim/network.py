@@ -16,6 +16,7 @@ from accord_tpu.api import MessageSink
 from accord_tpu.messages.base import Callback, Timeout
 from accord_tpu.primitives.timestamp import NodeId
 from accord_tpu.sim.queue import PendingQueue
+from accord_tpu.sim import wire
 from accord_tpu.utils.rng import RandomSource
 
 
@@ -41,10 +42,13 @@ class LinkConfig:
 
 class SimNetwork:
     def __init__(self, queue: PendingQueue, rng: RandomSource,
-                 timeout_ms: float = 1000.0):
+                 timeout_ms: float = 1000.0, serialize: bool = True):
         self.queue = queue
         self.rng = rng
         self.timeout_ms = timeout_ms
+        # round-trip every message through the wire codec so nodes never
+        # share live objects (reference: Journal reflection-diff discipline)
+        self.serialize = serialize
         self.nodes: Dict[NodeId, object] = {}  # node_id -> Node
         self._msg_ids = itertools.count(1)
         # msg_id -> (callback, replier may be any node, timeout handle)
@@ -100,27 +104,35 @@ class SimNetwork:
         if self._should_drop(src, dst):
             self.stats["dropped"] += 1
             return
+        # encode at send time: the receiver must observe the request as of
+        # the send, and must never share live state with the sender
+        payload = wire.encode(request) if self.serialize and src != dst else None
         ctx = ReplyContext(src, msg_id)
         node = self.nodes[dst]
-        self.queue.add(self._latency(src, dst),
-                       lambda: (self._count("delivered"),
-                                node.receive(request, src, ctx)))
+
+        def deliver():
+            self._count("delivered")
+            msg = wire.decode(payload) if payload is not None else request
+            node.receive(msg, src, ctx)
+
+        self.queue.add(self._latency(src, dst), deliver)
 
     def send_reply(self, src: NodeId, ctx: ReplyContext, reply) -> None:
         self.stats["replies"] += 1
         if self._should_drop(src, ctx.origin):
             self.stats["dropped"] += 1
             return
+        payload = wire.encode(reply) if self.serialize and src != ctx.origin else None
         self.queue.add(self._latency(src, ctx.origin),
-                       lambda: self._deliver_reply(src, ctx, reply))
+                       lambda: self._deliver_reply(src, ctx, reply, payload))
 
-    def _deliver_reply(self, src: NodeId, ctx: ReplyContext, reply) -> None:
+    def _deliver_reply(self, src: NodeId, ctx: ReplyContext, reply, payload=None) -> None:
         entry = self._pending.pop(ctx.msg_id, None)
         if entry is None:
             return  # no callback registered or already timed out
         callback, timeout_handle = entry
         timeout_handle.cancel()
-        callback.on_success(src, reply)
+        callback.on_success(src, wire.decode(payload) if payload is not None else reply)
 
     def _on_timeout(self, msg_id: int, dst: NodeId) -> None:
         entry = self._pending.pop(msg_id, None)
