@@ -132,9 +132,13 @@ class Recover(Callback):
             self._invalidate()
             return
 
-        # nothing beyond PreAccepted anywhere: fast-path reasoning
+        # nothing beyond PreAccepted anywhere: fast-path reasoning. A
+        # REJECTED witness (sync-point floor / expiry) also forces
+        # invalidation -- proposing would commit behind the floor.
         if self.tracker.rejects_fast_path() \
-                or any(ok.rejects_fast_path for ok in oks):
+                or any(ok.rejects_fast_path for ok in oks) \
+                or any(ok.execute_at is not None and ok.execute_at.is_rejected
+                       for ok in oks):
             self._invalidate()
             return
         eanw = Deps.merge([ok.earlier_accepted_no_witness for ok in oks])

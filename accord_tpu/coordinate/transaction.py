@@ -87,14 +87,44 @@ class CoordinateTransaction:
             self.node.events.on_fast_path_taken(self.txn_id)
             self._start_execute()
         else:
-            self.execute_at = max(ok.witnessed_at for ok in round_.oks.values())
+            self.execute_at = _merge_witnessed_all(
+                ok.witnessed_at for ok in round_.oks.values())
             self.deps = Deps.merge([ok.deps for ok in round_.oks.values()])
             self.node.events.on_slow_path_taken(self.txn_id)
+            if self.execute_at.is_rejected:
+                # a replica refused to witness us (behind an
+                # ExclusiveSyncPoint floor, or expired): invalidate instead of
+                # committing behind the floor (reference:
+                # CoordinateTransaction.java:87-89)
+                self._invalidate_rejected()
+                return
             Invariants.check_state(
                 self.execute_at.epoch == self.txn_id.epoch or
                 self.node.topology_manager.has_epoch(self.execute_at.epoch),
                 "executeAt epoch %s unknown", self.execute_at.epoch)
             self._start_propose()
+
+    def _invalidate_rejected(self) -> None:
+        """proposeAndCommitInvalidate at the original coordinator's ballot
+        (reference: Propose.Invalidate.proposeAndCommitInvalidate). Safe at
+        Ballot.ZERO: only the original coordinator uses ballot zero, and it
+        proposes either the txn or the invalidation, never both."""
+        from accord_tpu.coordinate.errors import Invalidated
+        from accord_tpu.coordinate.recover import propose_invalidate
+        from accord_tpu.messages.recover import CommitInvalidate
+
+        def committed(_):
+            topology = self.node.topology_manager.for_epoch(self.txn_id.epoch)
+            for to in topology.nodes():
+                self.node.send(to, CommitInvalidate(self.txn_id,
+                                                    self.route.participants))
+            self.node.events.on_invalidated(self.txn_id)
+            self._fail(Invalidated(f"{self.txn_id} rejected by sync-point floor"))
+
+        propose_invalidate(self.node, self.txn_id, self.ballot,
+                           self.route.home_key) \
+            .on_success(committed) \
+            .on_failure(self._fail)
 
     # -- phase 2 (slow path): Accept -----------------------------------------
     def _start_propose(self) -> None:
@@ -132,6 +162,16 @@ class CoordinateTransaction:
     @property
     def done(self) -> bool:
         return self.result.done
+
+
+def _merge_witnessed_all(timestamps) -> Timestamp:
+    """max with sticky rejection across every vote (see
+    Timestamp.merge_witnessed)."""
+    out = None
+    for ts in timestamps:
+        out = ts if out is None else Timestamp.merge_witnessed(out, ts)
+    Invariants.check_state(out is not None, "no witnessed timestamps")
+    return out
 
 
 class _PreAcceptRound(Callback):
@@ -281,10 +321,12 @@ class _ApplyRound(Callback):
     # through long partitions; durability rounds will replace this crutch
     MAX_ATTEMPTS = 64
 
-    def __init__(self, parent: CoordinateTransaction, writes, result):
+    def __init__(self, parent: CoordinateTransaction, writes, result,
+                 on_applied=None):
         self.parent = parent
         self.writes = writes
         self.result = result
+        self.on_applied = on_applied  # fires once a quorum has applied
         self.tracker = AppliedTracker(parent.topologies, parent.txn.keys)
         self.acked: set = set()
         self.attempts: Dict[int, int] = {}
@@ -301,14 +343,22 @@ class _ApplyRound(Callback):
 
     def on_success(self, from_node, reply) -> None:
         self.acked.add(from_node)
-        self.tracker.on_success(from_node)
+        if self.tracker.on_success(from_node) == RequestStatus.SUCCESS \
+                and self.on_applied is not None:
+            cb, self.on_applied = self.on_applied, None
+            cb()
 
     def on_failure(self, from_node, failure) -> None:
         if from_node in self.acked:
             return
         n = self.attempts.get(from_node, 0)
         if n >= self.MAX_ATTEMPTS:
-            self.tracker.on_failure(from_node)
+            if self.tracker.on_failure(from_node) == RequestStatus.FAILED \
+                    and self.on_applied is not None:
+                # a blocking caller (sync point / barrier) is waiting on the
+                # applied quorum: fail it rather than hang forever
+                self.on_applied = None
+                self.parent._fail(Timeout(f"apply {self.parent.txn_id}"))
             return
         self.attempts[from_node] = n + 1
         self.parent.node.send(from_node, self._message(), self)

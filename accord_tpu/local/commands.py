@@ -19,7 +19,7 @@ from accord_tpu.local.store import CommandStore
 from accord_tpu.primitives.deps import Deps
 from accord_tpu.primitives.keyspace import Keys, Ranges
 from accord_tpu.primitives.routes import Route
-from accord_tpu.primitives.timestamp import Ballot, Timestamp, TxnId
+from accord_tpu.primitives.timestamp import Ballot, Timestamp, TxnId, TxnKind
 from accord_tpu.primitives.txn import PartialTxn
 from accord_tpu.primitives.writes import Writes
 from accord_tpu.utils.invariants import Invariants
@@ -57,6 +57,10 @@ def preaccept(store: CommandStore, txn_id: TxnId, txn: PartialTxn, route: Route,
     cmd.promised = max(cmd.promised, ballot)
 
     if cmd.execute_at is None:
+        if txn_id.kind is TxnKind.EXCLUSIVE_SYNC_POINT:
+            # advance the reject floor BEFORE computing the witness timestamp
+            # (reference: PreAccept.java:101-103 + CommandStore.preaccept:333)
+            store.mark_exclusive_sync_point(txn_id, store.owned(txn.keys))
         # recovery (non-zero ballot) must not take new fast-path decisions
         witnessed = store.preaccept_timestamp(txn_id, store.owned(txn.keys),
                                               permit_fast_path=(ballot == Ballot.ZERO))
@@ -90,6 +94,12 @@ def accept(store: CommandStore, txn_id: TxnId, ballot: Ballot, route: Route,
             else AcceptOutcome.REJECTED_BALLOT
     if cmd.has_been(Status.COMMITTED):
         return AcceptOutcome.REDUNDANT
+    if not cmd.known_definition and cmd.execute_at is None \
+            and store.is_rejected_if_not_preaccepted(txn_id, keys):
+        # never witnessed here and below an ExclusiveSyncPoint floor: refuse
+        # the proposal rather than commit behind the floor (reference:
+        # CommandStore.isRejectedIfNotPreAccepted, local/CommandStore.java:589)
+        return AcceptOutcome.REJECTED_BALLOT
 
     cmd.route = route if cmd.route is None else cmd.route
     cmd.execute_at = execute_at
@@ -109,11 +119,10 @@ def recover(store: CommandStore, txn_id: TxnId, txn: PartialTxn, route: Route,
     """Ballot-gated witness for a BeginRecovery round (reference:
     Commands.recover via preacceptOrRecover, local/Commands.java:125-200):
     promise `ballot`, witnessing the txn first if this replica never saw it.
-    The witnessed-timestamp calculation is identical to preaccept, so a fresh
-    witness with no conflicts above txnId still reports a fast-path vote --
-    safe, because genuine fast-quorum members always report their original
-    witnessed timestamp and the recovery tracker's impossibility threshold
-    only counts electorate rejects."""
+    A fresh recovery witness never permits a fast-path vote: recovery wants
+    to invalidate txns their original coordinator did not complete
+    (reference: permitFastPath = ballot.equals(Ballot.ZERO),
+    local/Commands.java:163-169)."""
     cmd = store.command(txn_id)
     if cmd.is_(Status.TRUNCATED):
         return AcceptOutcome.TRUNCATED
@@ -123,8 +132,10 @@ def recover(store: CommandStore, txn_id: TxnId, txn: PartialTxn, route: Route,
     if not cmd.known_definition and not cmd.is_(Status.INVALIDATED):
         cmd.txn = txn
         cmd.route = route if cmd.route is None else cmd.route
+        if txn_id.kind is TxnKind.EXCLUSIVE_SYNC_POINT:
+            store.mark_exclusive_sync_point(txn_id, store.owned(txn.keys))
         witnessed = store.preaccept_timestamp(txn_id, store.owned(txn.keys),
-                                              permit_fast_path=True)
+                                              permit_fast_path=False)
         cmd.execute_at = witnessed
         cmd.status = Status.PRE_ACCEPTED
         store.register(txn_id, txn.keys, CfkStatus.WITNESSED, witnessed)
@@ -259,9 +270,15 @@ def apply(store: CommandStore, txn_id: TxnId, route: Route, txn: Optional[Partia
 def _init_waiting_on(store: CommandStore, cmd: Command) -> None:
     """Build WaitingOn from deps: every dep on a key/range this store owns
     gates us until it is committed; committed deps executing before us gate us
-    until applied (reference: Command.WaitingOn.Update + Commands.maybeExecute)."""
+    until applied (reference: Command.WaitingOn.Update + Commands.maybeExecute).
+
+    awaits_only_deps kinds (ExclusiveSyncPoint, EphemeralRead) have no logical
+    executeAt: they wait for EVERY dep to apply, even ones whose executeAt is
+    later (reference: Txn.Kind.awaitsOnlyDeps; PreAccept.java:275-283 explains
+    why an ESP must wait out deps that execute at arbitrary future points)."""
     wo = WaitingOn()
     cmd.waiting_on = wo
+    awaits_all = cmd.txn_id.kind.awaits_only_deps
     deps = cmd.deps.slice(store.ranges) if cmd.deps is not None else None
     if deps is None or deps.is_empty():
         return
@@ -272,7 +289,8 @@ def _init_waiting_on(store: CommandStore, cmd: Command) -> None:
         if dep.is_(Status.INVALIDATED):
             continue
         if dep.known_execute_at:
-            if dep.execute_at > cmd.execute_at or dep.has_been(Status.APPLIED):
+            if dep.has_been(Status.APPLIED) or \
+                    (not awaits_all and dep.execute_at > cmd.execute_at):
                 continue
             wo.apply.add(dep_id)
             dep.add_waiter(cmd.txn_id)
@@ -300,6 +318,10 @@ def _do_apply(store: CommandStore, cmd: Command) -> None:
     if cmd.writes is not None:
         cmd.writes.apply_to(store, store.ranges)
     cmd.status = Status.APPLIED
+    if cmd.txn_id.kind is TxnKind.EXCLUSIVE_SYNC_POINT:
+        # every conflicting txn below the ESP has now applied locally
+        store.mark_exclusive_sync_point_locally_applied(
+            cmd.txn_id, store.owned(cmd.txn.keys))
     store.register(cmd.txn_id, cmd.txn.keys, CfkStatus.APPLIED,
                    max(cmd.execute_at, cmd.txn_id.as_timestamp()), cmd.execute_at)
     store.node.events.on_applied(cmd, 0.0)
@@ -364,7 +386,9 @@ def _update_dependency(store: CommandStore, waiter: Command, dep: Command) -> No
         changed = True
     elif d in wo.commit and dep.known_execute_at:
         wo.commit.discard(d)
-        if dep.execute_at > waiter.execute_at or dep.has_been(Status.APPLIED):
+        awaits_all = waiter.txn_id.kind.awaits_only_deps
+        if dep.has_been(Status.APPLIED) or \
+                (not awaits_all and dep.execute_at > waiter.execute_at):
             dep.remove_waiter(waiter.txn_id)
         else:
             wo.apply.add(d)

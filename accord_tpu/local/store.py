@@ -48,6 +48,17 @@ class CommandStore:
         self.progress_log = (progress_log_factory(self) if progress_log_factory
                              else _NoopProgressLog())
         self.deps_resolver = deps_resolver  # None -> host scan below
+        # ExclusiveSyncPoint floor machinery (reference:
+        # local/CommandStore.java:301-317 + RedundantBefore.java:49):
+        #   reject_before  -- set at ESP *preaccept*: any later-arriving txn
+        #     with id below the floor gets a REJECTED witness timestamp, so
+        #     its coordinator invalidates it instead of committing behind the
+        #     sync point.
+        #   redundant_before -- set at ESP *local apply*: every conflicting
+        #     txn below it has applied locally; deps below the floor are
+        #     elided and (once shard-durable) state below it may be truncated.
+        self.reject_before: ReducingRangeMap = ReducingRangeMap.EMPTY
+        self.redundant_before: ReducingRangeMap = ReducingRangeMap.EMPTY
 
     # -- execution context ---------------------------------------------------
     def execute(self, fn: Callable[["CommandStore"], None]) -> AsyncResult:
@@ -121,15 +132,85 @@ class CommandStore:
     def preaccept_timestamp(self, txn_id: TxnId, seekables: Seekables,
                             permit_fast_path: bool) -> Timestamp:
         """Propose the witnessed timestamp for a PreAccept (reference:
-        CommandStore.preaccept, local/CommandStore.java:322): txnId itself iff
-        the fast path is still possible, else a fresh unique timestamp above
-        every witnessed conflict."""
+        CommandStore.preaccept, local/CommandStore.java:322-347): txnId itself
+        iff the fast path is still possible, else a fresh unique timestamp
+        above every witnessed conflict. A txn below an ExclusiveSyncPoint
+        floor (or past its preaccept expiry) gets a REJECTED timestamp, which
+        its coordinator turns into an invalidation."""
+        if self._rejects(txn_id, seekables):
+            return self.node.unique_now(txn_id.as_timestamp()).as_rejected()
+        if txn_id.kind is TxnKind.EXCLUSIVE_SYNC_POINT:
+            # an ESP always witnesses at its own id: it has no executeAt of
+            # its own, and marking the reject floor happened at registration
+            return txn_id
         min_non_conflicting = self.max_conflict_ts(seekables)
         if (permit_fast_path
                 and (min_non_conflicting is None or txn_id >= min_non_conflicting)
                 and txn_id.epoch >= self.node.epoch):
             return txn_id
         return self.node.unique_now(min_non_conflicting or txn_id)
+
+    def _rejects(self, txn_id: TxnId, seekables: Seekables) -> bool:
+        """Reject-before fold + expiry (reference: CommandStore.preaccept
+        :326-331). Expiry never applies to sync points."""
+        if not self.reject_before.is_empty():
+            acc = False
+            if isinstance(seekables, Keys):
+                for k in seekables:
+                    floor = self.reject_before.get(k)
+                    if floor is not None and txn_id.as_timestamp() < floor:
+                        return True
+            else:
+                def fold(hit, floor):
+                    return hit or txn_id.as_timestamp() < floor
+                for r in seekables:
+                    acc = self.reject_before.fold_over_range(r.start, r.end, fold, acc)
+                if acc:
+                    return True
+        if not txn_id.kind.is_sync_point:
+            timeout_us = self.node.agent.pre_accept_timeout_ms() * 1000.0
+            if self.node.time_service.now_micros() - txn_id.hlc >= timeout_us:
+                return True
+        return False
+
+    def mark_exclusive_sync_point(self, txn_id: TxnId, seekables: Seekables) -> None:
+        """At ESP preaccept: advance the reject floor (reference:
+        CommandStore.markExclusiveSyncPoint, local/CommandStore.java:301)."""
+        ts = txn_id.as_timestamp()
+        for r in _as_ranges(seekables):
+            self.reject_before = self.reject_before.with_range(
+                r.start, r.end, ts, Timestamp.merge_max)
+
+    def mark_exclusive_sync_point_locally_applied(self, txn_id: TxnId,
+                                                  seekables: Seekables) -> None:
+        """At ESP local apply: every conflicting txn below it has applied
+        locally -- advance RedundantBefore (reference:
+        CommandStore.markExclusiveSyncPointLocallyApplied, :310)."""
+        ts = txn_id.as_timestamp()
+        for r in _as_ranges(seekables):
+            self.redundant_before = self.redundant_before.with_range(
+                r.start, r.end, ts, Timestamp.merge_max)
+
+    def redundant_before_at(self, key) -> Optional[Timestamp]:
+        return self.redundant_before.get(key)
+
+    def is_rejected_if_not_preaccepted(self, txn_id: TxnId,
+                                       seekables: Seekables) -> bool:
+        """Would the reject floor refuse this txn were it arriving now?
+        (reference: CommandStore.isRejectedIfNotPreAccepted,
+        local/CommandStore.java:589 -- gates Accept/inference for txns this
+        store never witnessed)."""
+        if self.reject_before.is_empty():
+            return False
+        ts = txn_id.as_timestamp()
+        if isinstance(seekables, Keys):
+            return any((floor := self.reject_before.get(k)) is not None
+                       and ts < floor for k in seekables)
+        hit = False
+        for r in seekables:
+            hit = self.reject_before.fold_over_range(
+                r.start, r.end, lambda acc, floor: acc or ts < floor, hit)
+        return hit
 
     def calculate_deps(self, txn_id: TxnId, seekables: Seekables,
                        before: Timestamp) -> Deps:
@@ -248,6 +329,10 @@ class CommandStore:
                 prev = self.range_txns.get(txn_id)
                 self.range_txns[txn_id] = prev.union(owned) if prev else owned
         self.update_max_conflicts(owned, witnessed_at)
+
+
+def _as_ranges(seekables: Seekables) -> Ranges:
+    return seekables if isinstance(seekables, Ranges) else seekables.to_ranges()
 
 
 class _NoopProgressLog:

@@ -9,6 +9,9 @@ from accord_tpu.messages.recover import (
     CommitInvalidate, DepsEntry, DepsTier, InvalidateNack, InvalidateOk,
     RecoverNack, RecoverOk, WaitOnCommit, WaitOnCommitOk,
 )
+from accord_tpu.messages.wait import (
+    AppliedOk, ApplyThenWaitUntilApplied, WaitUntilApplied,
+)
 
 __all__ = [
     "Request", "Reply", "Callback", "SimpleReply",
@@ -20,4 +23,5 @@ __all__ = [
     "WaitOnCommit", "WaitOnCommitOk",
     "AcceptInvalidate", "InvalidateOk", "InvalidateNack", "CommitInvalidate",
     "CheckStatus", "CheckStatusOk",
+    "AppliedOk", "ApplyThenWaitUntilApplied", "WaitUntilApplied",
 ]

@@ -36,8 +36,10 @@ class PreAccept(Request):
         def reduce_fn(a, b):
             if isinstance(a, PreAcceptNack) or isinstance(b, PreAcceptNack):
                 return a if isinstance(a, PreAcceptNack) else b
-            # (reference: PreAcceptOk reduce, messages/PreAccept.java:141-156)
-            return PreAcceptOk(self.txn_id, max(a.witnessed_at, b.witnessed_at),
+            # (reference: PreAcceptOk reduce, messages/PreAccept.java:141-156;
+            # merge_witnessed keeps one store's rejection sticky across stores)
+            return PreAcceptOk(self.txn_id,
+                               Timestamp.merge_witnessed(a.witnessed_at, b.witnessed_at),
                                a.deps.union(b.deps))
 
         node.command_stores.map_reduce(self.txn.keys, map_fn, reduce_fn) \

@@ -146,6 +146,14 @@ class Timestamp:
     def __hash__(self) -> int:
         return hash(self._key())
 
+    # -- rejection flag (reference: Timestamp.REJECTED_FLAG / asRejected) ----
+    @property
+    def is_rejected(self) -> bool:
+        return bool(self.flags & REJECTED_FLAG)
+
+    def as_rejected(self) -> "Timestamp":
+        return Timestamp(self.epoch, self.hlc, self.flags | REJECTED_FLAG, self.node)
+
     # -- derivation ----------------------------------------------------------
     def with_next_hlc(self) -> "Timestamp":
         return Timestamp(self.epoch, self.hlc + 1, 0, self.node)
@@ -163,6 +171,18 @@ class Timestamp:
         if b is None:
             return a
         return a if a >= b else b
+
+    @staticmethod
+    def merge_witnessed(a: "Timestamp", b: "Timestamp") -> "Timestamp":
+        """Max of two witnessed timestamps with STICKY rejection: if either
+        vote was rejected (sync-point floor / expiry), the merged result stays
+        rejected even when the other vote has a higher hlc -- otherwise a
+        later clean unique_now from a sibling store would silently mask the
+        rejection and let a txn commit behind an ExclusiveSyncPoint floor."""
+        m = a if a >= b else b
+        if (a.is_rejected or b.is_rejected) and not m.is_rejected:
+            m = m.as_rejected()
+        return m
 
     # -- tensor encoding -----------------------------------------------------
     def pack(self) -> Tuple[int, int]:

@@ -1,0 +1,244 @@
+"""Sync points, barriers and the ExclusiveSyncPoint floor.
+
+Mirrors the reference's sync-point semantics (coordinate/
+CoordinateSyncPoint.java:58, Barrier.java:64, CommandStore.java:301-317):
+  - an inclusive sync point captures every conflicting txn started before it
+  - a blocking barrier completes only after those deps have applied
+  - an ExclusiveSyncPoint advances a reject floor: later-arriving txns with
+    older ids are refused and invalidated rather than committed behind it
+  - an applied ESP advances RedundantBefore on every owning store
+"""
+from __future__ import annotations
+
+import pytest
+
+from accord_tpu.coordinate.errors import Invalidated
+from accord_tpu.coordinate.syncpoint import Barrier, CoordinateSyncPoint
+from accord_tpu.local.status import Status
+from accord_tpu.primitives.keyspace import Keys, Range, Ranges
+from accord_tpu.primitives.syncpoint import SyncPoint
+from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.sim.cluster import Cluster, ClusterConfig
+from accord_tpu.sim.list_store import ListQuery, ListRead, ListUpdate
+
+
+def write_txn(keys: Keys, value: int) -> Txn:
+    return Txn(TxnKind.WRITE, keys, read=ListRead(keys),
+               update=ListUpdate(keys, value), query=ListQuery())
+
+
+def run(cluster, result, max_events=200_000):
+    cluster.drain(max_events)
+    cluster.check_no_failures()
+    assert result.done, "coordination did not complete"
+    return result
+
+
+def test_inclusive_sync_point_captures_prior_writes():
+    cluster = Cluster(seed=42)
+    node = cluster.nodes[1]
+    keys = Keys([100, 9000])
+    r1 = node.coordinate(write_txn(keys, 1))
+    cluster.drain()
+    sp_result = CoordinateSyncPoint.inclusive(node, keys)
+    run(cluster, sp_result)
+    sp = sp_result.value()
+    assert isinstance(sp, SyncPoint)
+    assert sp.sync_id.kind is TxnKind.SYNC_POINT
+    # the prior write must be in the waitFor set
+    write_id = r1.value().txn_id
+    assert sp.wait_for.contains(write_id)
+
+
+def test_blocking_barrier_waits_for_applies():
+    cluster = Cluster(seed=7)
+    node = cluster.nodes[2]
+    keys = Keys([5, 60000])
+    for v in range(1, 4):
+        node.coordinate(write_txn(keys, v))
+    barrier = Barrier.global_sync(node, keys)
+    run(cluster, barrier)
+    sp = barrier.value()
+    # at barrier completion a quorum has applied the sync point, which can
+    # only happen after its deps applied; spot-check the coordinator node
+    for store in node.command_stores.all():
+        if store.owns(keys):
+            cmd = store.command_if_present(sp.sync_id)
+            assert cmd is not None and cmd.has_been(Status.APPLIED)
+            for dep_id in (cmd.deps.all_txn_ids() if cmd.deps else ()):
+                dep = store.command_if_present(dep_id)
+                if dep is not None and not dep.status.is_terminal \
+                        and store.owns(dep.txn.keys if dep.txn else keys):
+                    assert dep.has_been(Status.APPLIED)
+
+
+def test_local_barrier():
+    cluster = Cluster(seed=11)
+    node = cluster.nodes[1]
+    keys = Keys([1234])
+    node.coordinate(write_txn(keys, 9))
+    barrier = Barrier.local(node, keys)
+    run(cluster, barrier)
+
+
+def test_exclusive_sync_point_over_ranges():
+    cluster = Cluster(seed=13)
+    node = cluster.nodes[1]
+    ranges = Ranges([Range(0, 1 << 16)])
+    for v in range(1, 3):
+        node.coordinate(write_txn(Keys([10 + v, 40000 + v]), v))
+    sp_result = CoordinateSyncPoint.exclusive(node, ranges)
+    run(cluster, sp_result)
+    sp = sp_result.value()
+    assert sp.sync_id.kind is TxnKind.EXCLUSIVE_SYNC_POINT
+    assert sp.sync_id.domain is Domain.RANGE
+    # after stabilisation every replica's reject floor covers the ranges
+    floors = 0
+    for n in cluster.nodes.values():
+        for store in n.command_stores.all():
+            if store.reject_before.get(100) is not None:
+                floors += 1
+    assert floors > 0
+
+
+def test_esp_floor_rejects_older_txn():
+    """A txn whose id predates a witnessed ESP must invalidate, not commit."""
+    cluster = Cluster(seed=17)
+    node = cluster.nodes[1]
+    ranges = Ranges([Range(0, 1 << 16)])
+    keys = Keys([777])
+
+    # allocate an old txn id NOW (before the ESP) but submit it only after
+    old_id = node.next_txn_id(TxnKind.WRITE, Domain.KEY)
+    sp_result = CoordinateSyncPoint.exclusive(node, ranges)
+    run(cluster, sp_result)
+    assert sp_result.value().sync_id > old_id
+
+    late = node.coordinate(write_txn(keys, 999), txn_id=old_id)
+    cluster.drain()
+    cluster.check_no_failures()
+    assert late.done
+    assert isinstance(late.failure, Invalidated), f"got {late.failure!r}"
+    # and the value 999 must never surface anywhere
+    for store in cluster.stores.values():
+        for key, entries in store.data.items():
+            assert all(v != 999 for _, v in entries)
+
+
+def test_esp_apply_advances_redundant_before():
+    cluster = Cluster(seed=19)
+    node = cluster.nodes[1]
+    ranges = Ranges([Range(0, 1 << 16)])
+    node.coordinate(write_txn(Keys([50, 50000]), 1))
+    sp_result = CoordinateSyncPoint.exclusive(node, ranges)
+    run(cluster, sp_result)
+    sp = sp_result.value()
+    # drain the background Apply round; the ESP applies once deps applied
+    cluster.drain()
+    advanced = 0
+    for n in cluster.nodes.values():
+        for store in n.command_stores.all():
+            cmd = store.command_if_present(sp.sync_id)
+            if cmd is not None and cmd.has_been(Status.APPLIED):
+                assert store.redundant_before.get(50) == sp.sync_id.as_timestamp() \
+                    or store.redundant_before.get(50000) == sp.sync_id.as_timestamp()
+                advanced += 1
+    assert advanced > 0
+
+
+def test_wait_until_applied_message():
+    """Drive WaitUntilApplied directly: a node replies only after the txn has
+    fully applied locally (reference: messages/WaitUntilApplied.java)."""
+    from accord_tpu.messages import AppliedOk, WaitUntilApplied
+    from accord_tpu.messages.base import Callback
+
+    cluster = Cluster(seed=29)
+    node = cluster.nodes[1]
+    keys = Keys([42])
+    r = node.coordinate(write_txn(keys, 5))
+    run(cluster, r)
+    txn_id = r.value().txn_id
+
+    got = []
+
+    class Cb(Callback):
+        def on_success(self, from_node, reply):
+            got.append((from_node, reply))
+
+        def on_failure(self, from_node, failure):
+            raise AssertionError(failure)
+
+    for to in (2, 3):
+        node.send(to, WaitUntilApplied(txn_id, keys), Cb())
+    cluster.drain()
+    assert len(got) == 2
+    assert all(isinstance(reply, AppliedOk) and reply.txn_id == txn_id
+               for _, reply in got)
+
+
+def test_apply_then_wait_until_applied_teaches_unknown_replica():
+    """ApplyThenWaitUntilApplied carries the full decision: a replica that
+    never learned the sync point applies it and replies
+    (reference: messages/ApplyThenWaitUntilApplied.java)."""
+    from accord_tpu.messages import AppliedOk, ApplyThenWaitUntilApplied
+    from accord_tpu.messages.base import Callback
+
+    cluster = Cluster(seed=31)
+    node = cluster.nodes[1]
+    ranges = Ranges([Range(0, 1 << 16)])
+    sp_result = CoordinateSyncPoint.exclusive(node, ranges)
+    run(cluster, sp_result)
+    sp = sp_result.value()
+    cluster.drain()
+
+    # simulate a replica that lost all trace of the sync point
+    victim = cluster.nodes[3]
+    for store in victim.command_stores.all():
+        store.commands.pop(sp.sync_id, None)
+
+    got = []
+
+    class Cb(Callback):
+        def on_success(self, from_node, reply):
+            got.append(reply)
+
+        def on_failure(self, from_node, failure):
+            raise AssertionError(failure)
+
+    txn = node.agent.empty_txn(sp.sync_id.kind, sp.seekables)
+    node.send(3, ApplyThenWaitUntilApplied(
+        sp.sync_id, sp.route, txn, sp.sync_id.as_timestamp(), sp.wait_for), Cb())
+    cluster.drain()
+    assert len(got) == 1 and isinstance(got[0], AppliedOk)
+    for store in victim.command_stores.all():
+        cmd = store.command_if_present(sp.sync_id)
+        assert cmd is not None and cmd.has_been(Status.APPLIED)
+
+
+def test_rejection_survives_witness_merge():
+    """A rejected witness from one store must not be masked by a later clean
+    timestamp from a sibling store (sticky rejection in merge_witnessed)."""
+    from accord_tpu.primitives.timestamp import Timestamp
+
+    clean = Timestamp(1, 100, 0, 1)
+    rejected = Timestamp(1, 50, 0, 2).as_rejected()
+    merged = Timestamp.merge_witnessed(clean, rejected)
+    assert merged.is_rejected
+    assert merged.hlc == 100  # value is still the max
+    merged2 = Timestamp.merge_witnessed(rejected, clean)
+    assert merged2.is_rejected
+
+
+def test_esp_waits_for_later_executing_dep():
+    """awaits_only_deps: an ESP whose dep executes AFTER the ESP's id still
+    waits for it (reference: PreAccept.java:275-283)."""
+    cluster = Cluster(seed=23)
+    node = cluster.nodes[1]
+    keys = Keys([321])
+    # a write that will (very likely) take the fast path and execute quickly
+    node.coordinate(write_txn(keys, 1))
+    sp_result = CoordinateSyncPoint.exclusive(node, Ranges([Range(0, 1 << 16)]))
+    run(cluster, sp_result)
+    cluster.drain()
+    cluster.check_no_failures()
