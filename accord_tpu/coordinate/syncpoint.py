@@ -49,14 +49,23 @@ class CoordinateSyncPoint(CoordinateTransaction):
                                blocking=blocking)
 
     @classmethod
-    def _coordinate(cls, node, kind: TxnKind, seekables: Seekables,
-                    blocking: bool) -> AsyncResult:
+    def build(cls, node, kind: TxnKind, seekables: Seekables,
+              blocking: bool = False) -> "CoordinateSyncPoint":
+        """Create without sending anything: the caller may need the txn_id
+        before the first message goes out (Bootstrap sets its floor from it)."""
         txn = node.agent.empty_txn(kind, seekables)
         txn_id = node.next_txn_id(kind, seekables.domain)
         route = node.compute_route(txn)
-        self = cls(node, txn_id, txn, route, blocking)
+        return cls(node, txn_id, txn, route, blocking)
+
+    def start(self) -> AsyncResult:
         self._start_preaccept()
         return self.result
+
+    @classmethod
+    def _coordinate(cls, node, kind: TxnKind, seekables: Seekables,
+                    blocking: bool) -> AsyncResult:
+        return cls.build(node, kind, seekables, blocking).start()
 
     # -- adapter policy overrides -------------------------------------------
     def _on_preaccepted(self, round_) -> None:

@@ -285,6 +285,10 @@ def _init_waiting_on(store: CommandStore, cmd: Command) -> None:
     for dep_id in deps.all_txn_ids():
         if dep_id == cmd.txn_id:
             continue
+        if store.dep_elided_by_floor(cmd, dep_id):
+            # below a bootstrap floor: its effects arrived with the fetched
+            # snapshot; it will never individually apply on this store
+            continue
         dep = store.command(dep_id)
         if dep.is_(Status.INVALIDATED):
             continue

@@ -66,17 +66,52 @@ class Node:
 
     # -- topology ------------------------------------------------------------
     def on_topology_update(self, topology) -> None:
+        """(reference: Node.onTopologyUpdate, local/Node.java:248): register
+        the epoch, recompute store ownership, bootstrap added ranges, then
+        announce sync-complete to the cluster."""
+        if self.topology_manager.has_epoch(topology.epoch):
+            return
         self.topology_manager.on_topology_update(topology)
-        owned = topology.ranges_for_node(self.id)
         if self.command_stores is None:
             kwargs = {}
             if self._store_factory is not None:
                 kwargs["store_factory"] = self._store_factory
+            # stores carve up the WHOLE cluster domain; ownership per epoch
+            # is applied by update_topology below
             self.command_stores = CommandStores(
-                self, self._num_stores, owned,
+                self, self._num_stores, topology.ranges(),
                 progress_log_factory=self._progress_log_factory,
                 deps_resolver=self._deps_resolver, **kwargs)
-        # range movement handled by the topology-change milestone
+        epoch = topology.epoch
+        self.command_stores.update_topology(topology) \
+            .on_success(lambda _: self._on_epoch_locally_synced(epoch)) \
+            .on_failure(self.agent.on_uncaught_exception)
+
+    def _on_epoch_locally_synced(self, epoch: int) -> None:
+        """All added ranges bootstrapped: ack the epoch to the cluster
+        (reference: ConfigurationService.acknowledgeEpoch +
+        Listener.onEpochSyncComplete gossip)."""
+        from accord_tpu.messages.epoch import EpochSyncComplete
+        self.topology_manager.on_epoch_sync_complete(self.id, epoch)
+        self.config_service.acknowledge_epoch(epoch)
+        if epoch <= 1:
+            return  # genesis epoch is born synced; no gossip needed
+        targets = set(self.topology_manager.for_epoch(epoch).nodes())
+        if self.topology_manager.has_epoch(epoch - 1):
+            # superseded replicas track sync too: they serve until handover
+            targets |= set(self.topology_manager.for_epoch(epoch - 1).nodes())
+        for to in sorted(targets):
+            if to != self.id:
+                _ReliableSend(self, to, EpochSyncComplete(self.id, epoch)).send()
+
+    def with_epoch(self, epoch: int, fn: Callable[[], None]) -> None:
+        """Run fn once the topology for `epoch` is known locally (reference:
+        Node.withEpoch, local/Node.java:596)."""
+        if epoch <= self.epoch or self.topology_manager.has_epoch(epoch):
+            fn()
+            return
+        self.config_service.fetch_topology_for_epoch(epoch)
+        self.topology_manager.await_epoch(epoch).on_success(lambda _: fn())
 
     @property
     def epoch(self) -> int:
@@ -130,24 +165,50 @@ class Node:
             self.send(to, request_factory(to), callback)
 
     def reply(self, to: NodeId, reply_context, reply) -> None:
+        if reply is None:
+            # nothing to say (e.g. no local store intersected the scope):
+            # stay silent and let the sender's timeout/escalation handle it
+            return
         self.message_sink.reply(to, reply_context, reply)
 
     def receive(self, request, from_node: NodeId, reply_context) -> None:
         """Ingress for protocol requests (reference: Node.receive,
         local/Node.java:718): defers until the request's epoch is known."""
         wait_for = getattr(request, "wait_for_epoch", 0)
-        if wait_for > self.epoch:
-            self.config_service.fetch_topology_for_epoch(wait_for)
-            self.topology_manager.await_epoch(wait_for).on_success(
-                lambda _: self.receive(request, from_node, reply_context))
-            return
-        self.scheduler.now(lambda: self._process(request, from_node, reply_context))
+        self.with_epoch(wait_for, lambda: self.scheduler.now(
+            lambda: self._process(request, from_node, reply_context)))
 
     def _process(self, request, from_node: NodeId, reply_context) -> None:
         try:
             request.process(self, from_node, reply_context)
         except BaseException as e:  # noqa: BLE001 -- agent decides
             self.agent.on_uncaught_exception(e)
+
+
+class _ReliableSend:
+    """Fire-and-forget with retries: epoch gossip must survive chaos, so
+    re-send on timeout/failure with backoff until acked or exhausted."""
+
+    def __init__(self, node: Node, to: NodeId, request, attempts: int = 30,
+                 backoff_ms: float = 250.0):
+        self.node = node
+        self.to = to
+        self.request = request
+        self.attempts = attempts
+        self.backoff_ms = backoff_ms
+
+    def send(self) -> None:
+        self.node.send(self.to, self.request, self)
+
+    def on_success(self, from_node, reply) -> None:
+        pass
+
+    def on_failure(self, from_node, failure) -> None:
+        if self.attempts <= 0:
+            return
+        self.attempts -= 1
+        self.node.scheduler.once(self.backoff_ms, self.send)
+        self.backoff_ms = min(self.backoff_ms * 1.5, 2000.0)
 
 
 def _pick_home_key(seekables: Seekables):

@@ -37,7 +37,19 @@ class CommandStore:
                  deps_resolver=None):
         self.store_id = store_id
         self.node = node
-        self.ranges = ranges  # owned ranges (static until topology-change milestone)
+        # `ranges` is this store's FIXED slice of the global key domain: the
+        # intra-node partition is stable across topology changes, so per-key
+        # state never migrates between stores (a deliberate re-design of the
+        # reference's dynamic RangesForEpoch splits, local/CommandStores.java:143;
+        # stable slices keep the TPU active-set buffers append-only).
+        self.slice_ranges = ranges
+        # what the node actually owns of this slice, per epoch (reference:
+        # CommandStores.RangesForEpoch, local/CommandStores.java:143-335)
+        self._owned_by_epoch: Dict[int, Ranges] = {}
+        self._owned_union: Ranges = Ranges.EMPTY
+        # ranges this store may serve reads for (gated by bootstrap;
+        # reference: CommandStore.safeToRead)
+        self.safe_to_read: Ranges = Ranges.EMPTY
         self.commands: Dict[TxnId, Command] = {}
         self.cfks: Dict[Key, CommandsForKey] = {}
         self.range_txns: Dict[TxnId, Ranges] = {}  # witnessed range-domain txns
@@ -59,6 +71,10 @@ class CommandStore:
         #     elided and (once shard-durable) state below it may be truncated.
         self.reject_before: ReducingRangeMap = ReducingRangeMap.EMPTY
         self.redundant_before: ReducingRangeMap = ReducingRangeMap.EMPTY
+        # bootstrap floor (reference: CommandStore.bootstrapBeganAt +
+        # RedundantBefore.bootstrappedAt): deps below it within bootstrapped
+        # ranges were covered by the fetched snapshot -- never waited on
+        self.bootstrapped_at: ReducingRangeMap = ReducingRangeMap.EMPTY
 
     # -- execution context ---------------------------------------------------
     def execute(self, fn: Callable[["CommandStore"], None]) -> AsyncResult:
@@ -87,6 +103,42 @@ class CommandStore:
             c = CommandsForKey(key)
             self.cfks[key] = c
         return c
+
+    # -- epoch-aware ownership ----------------------------------------------
+    @property
+    def ranges(self) -> Ranges:
+        """Union of owned ranges over every known epoch: the conservative
+        scope for witnessing/scans (old-epoch coordinations must still find
+        their conflicts here after a handover)."""
+        return self._owned_union
+
+    def set_owned(self, epoch: int, owned: Ranges) -> tuple:
+        """Record what this store owns at `epoch`; returns (added, removed)
+        vs the newest prior epoch (reference: CommandStores.updateTopology,
+        local/CommandStores.java:646)."""
+        prev_epochs = [e for e in self._owned_by_epoch if e < epoch]
+        prev = self._owned_by_epoch[max(prev_epochs)] if prev_epochs else Ranges.EMPTY
+        self._owned_by_epoch[epoch] = owned
+        self._owned_union = self._owned_union.union(owned)
+        return owned.difference(prev), prev.difference(owned)
+
+    def current_owned(self) -> Ranges:
+        if not self._owned_by_epoch:
+            return Ranges.EMPTY
+        return self._owned_by_epoch[max(self._owned_by_epoch)]
+
+    def mark_safe_to_read(self, ranges: Ranges) -> None:
+        self.safe_to_read = self.safe_to_read.union(ranges)
+
+    def clear_safe_to_read(self, ranges: Ranges) -> None:
+        self.safe_to_read = self.safe_to_read.difference(ranges)
+
+    def is_safe_to_read(self, seekables: Seekables) -> bool:
+        """Every owned part of `seekables` must be within the safe set."""
+        owned = self.owned(seekables)
+        if isinstance(owned, Keys):
+            return all(self.safe_to_read.contains_key(k) for k in owned)
+        return self.safe_to_read.contains_ranges(_as_ranges(owned))
 
     # -- ownership -----------------------------------------------------------
     def owns(self, seekables: Seekables) -> bool:
@@ -191,8 +243,50 @@ class CommandStore:
             self.redundant_before = self.redundant_before.with_range(
                 r.start, r.end, ts, Timestamp.merge_max)
 
-    def redundant_before_at(self, key) -> Optional[Timestamp]:
-        return self.redundant_before.get(key)
+    # -- bootstrap floor (reference: local/Bootstrap.java:81 doc :28-80) -----
+    def set_bootstrap_floor(self, sync_id: TxnId, ranges: Ranges) -> None:
+        """The bootstrap's ExclusiveSyncPoint id becomes the floor for
+        `ranges`: everything ordered below it arrives via the fetched snapshot
+        rather than individual applies, so waiting on such deps would hang.
+        Re-evaluates every blocked command since previously-registered waits
+        may now be elided."""
+        ts = sync_id.as_timestamp()
+        for r in ranges:
+            self.bootstrapped_at = self.bootstrapped_at.with_range(
+                r.start, r.end, ts, Timestamp.merge_max)
+        from accord_tpu.local import commands as _commands
+        for cmd in list(self.commands.values()):
+            wo = cmd.waiting_on
+            if wo is None:
+                continue
+            changed = False
+            for dep_id in list(wo.commit | wo.apply):
+                if self.dep_elided_by_floor(cmd, dep_id):
+                    wo.commit.discard(dep_id)
+                    wo.apply.discard(dep_id)
+                    changed = True
+            if changed and wo.is_done():
+                self.node.scheduler.once(
+                    0.0, lambda c=cmd: _commands.maybe_execute(self, c))
+
+    def dep_elided_by_floor(self, cmd, dep_id: TxnId) -> bool:
+        """True when the dep's effects came with a bootstrap snapshot, so it
+        will never individually apply here. A dep gates the waiter only
+        through keys both own in this store; if EVERY owned key of the waiter
+        is floored above the dep, every shared key is too -- safe to elide."""
+        if self.bootstrapped_at.is_empty() or cmd.txn is None:
+            return False
+        ts = dep_id.as_timestamp()
+        owned = self.owned(cmd.txn.keys)
+        if isinstance(owned, Keys):
+            if len(owned) == 0:
+                return False
+            return all((f := self.bootstrapped_at.get(k)) is not None and ts < f
+                       for k in owned)
+        if owned.is_empty():
+            return False
+        return all(self.bootstrapped_at.covers(r.start, r.end, lambda f: ts < f)
+                   for r in _as_ranges(owned))
 
     def is_rejected_if_not_preaccepted(self, txn_id: TxnId,
                                        seekables: Seekables) -> bool:

@@ -36,14 +36,18 @@ def even_int_splitter(rng: Range, parts: int) -> List[Range]:
 
 
 class CommandStores:
-    def __init__(self, node: "Node", num_stores: int, owned: Ranges,
+    def __init__(self, node: "Node", num_stores: int, global_ranges: Ranges,
                  splitter: Callable[[Range, int], List[Range]] = even_int_splitter,
                  progress_log_factory=None, deps_resolver=None,
                  store_factory: Callable[..., CommandStore] = CommandStore):
+        """`global_ranges` is the WHOLE cluster key domain: each store gets a
+        fixed 1/num_stores slice of it, and topology changes only adjust what
+        the node owns of each slice (update_topology). The stable intra-node
+        partition means per-key state never migrates between stores."""
         self.node = node
         self.splitter = splitter
         per_store: List[List[Range]] = [[] for _ in range(num_stores)]
-        for rng in owned:
+        for rng in global_ranges:
             pieces = splitter(rng, num_stores)
             if len(pieces) < num_stores:
                 # unsplittable: give whole pieces to store 0..
@@ -56,6 +60,33 @@ class CommandStores:
             store_factory(i, node, Ranges(rs), progress_log_factory, deps_resolver)
             for i, rs in enumerate(per_store)
         ]
+
+    # -- topology change (reference: CommandStores.updateTopology,
+    # local/CommandStores.java:646) ------------------------------------------
+    def update_topology(self, topology) -> AsyncResult:
+        """Apply a new epoch: recompute each store's owned share of its slice;
+        ranges gained relative to the prior epoch are bootstrapped (history
+        acquired + safe-to-read gating) before the returned result fires."""
+        owned = topology.ranges_for_node(self.node.id)
+        pending: List[AsyncResult] = []
+        for s in self.stores:
+            new_owned = owned.intersection(s.slice_ranges)
+            added, removed = s.set_owned(topology.epoch, new_owned)
+            if not removed.is_empty():
+                # a removed range's history goes stale here the moment the
+                # new owners take writes; if it ever comes back, only a fresh
+                # bootstrap may re-mark it safe
+                s.clear_safe_to_read(removed)
+            if not added.is_empty():
+                pending.append(self._bootstrap(s, topology.epoch, added))
+        if not pending:
+            from accord_tpu.utils.async_ import success
+            return success(None)
+        return all_of(pending).map(lambda _: None)
+
+    def _bootstrap(self, store: CommandStore, epoch: int, added: Ranges) -> AsyncResult:
+        from accord_tpu.local.bootstrap import Bootstrap
+        return Bootstrap.run(self.node, store, epoch, added)
 
     # -- selection -----------------------------------------------------------
     def intersecting(self, seekables: Seekables) -> List[CommandStore]:
@@ -84,9 +115,12 @@ class CommandStores:
         execution context), reduce the results (reference:
         CommandStores.mapReduceConsume, local/CommandStores.java:626)."""
         targets = self.intersecting(seekables)
-        Invariants.check_state(bool(targets),
-                               "no store intersects %s (owned=%s)", seekables,
-                               self.owned_ranges())
+        if not targets:
+            # topology churn can deliver a request for ranges this node has
+            # never owned (e.g. a read sliced below the route); reduce of
+            # nothing is None and the caller decides how to reply
+            from accord_tpu.utils.async_ import success
+            return success(None)
         chains = [s.submit(map_fn) for s in targets]
         return all_of(chains).map(lambda vs: _reduce_non_null(vs, reduce_fn))
 

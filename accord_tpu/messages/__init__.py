@@ -12,6 +12,8 @@ from accord_tpu.messages.recover import (
 from accord_tpu.messages.wait import (
     AppliedOk, ApplyThenWaitUntilApplied, WaitUntilApplied,
 )
+from accord_tpu.messages.fetch import FetchData, FetchOk
+from accord_tpu.messages.epoch import EpochSyncComplete
 
 __all__ = [
     "Request", "Reply", "Callback", "SimpleReply",
@@ -24,4 +26,5 @@ __all__ = [
     "AcceptInvalidate", "InvalidateOk", "InvalidateNack", "CommitInvalidate",
     "CheckStatus", "CheckStatusOk",
     "AppliedOk", "ApplyThenWaitUntilApplied", "WaitUntilApplied",
+    "FetchData", "FetchOk", "EpochSyncComplete",
 ]

@@ -1,0 +1,55 @@
+"""Bootstrap data fetch.
+
+Role-equivalent to the reference's DataStore fetch protocol
+(api/DataStore.java:39-113: FetchRanges/FetchResult) driven by
+AbstractFetchCoordinator's FetchRequest -- itself a ReadData subclass
+(impl/AbstractFetchCoordinator.java:60,238): the source replica waits until
+the bootstrap's ExclusiveSyncPoint has applied locally (so its snapshot
+contains every txn below the floor), then streams the requested ranges.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from accord_tpu.messages.base import Reply, Request
+from accord_tpu.messages.wait import when_locally_applied
+from accord_tpu.primitives.keyspace import Ranges
+from accord_tpu.primitives.timestamp import TxnId
+
+
+class FetchData(Request):
+    def __init__(self, sync_id: TxnId, scope: Ranges, ranges: Ranges):
+        self.sync_id = sync_id     # the bootstrap's ExclusiveSyncPoint
+        self.scope = scope         # the sync point's full seekables
+        self.ranges = ranges       # the slice this source should stream
+        self.wait_for_epoch = sync_id.epoch
+
+    @property
+    def has_side_effects(self) -> bool:
+        return False
+
+    def process(self, node, from_node, reply_context) -> None:
+        def respond():
+            data: Dict[object, Tuple] = {}
+            for key, entries in node.data_store.data.items():
+                if self.ranges.contains_key(key):
+                    data[key] = tuple(entries)
+            node.reply(from_node, reply_context,
+                       FetchOk(self.sync_id, self.ranges, data))
+
+        when_locally_applied(node, self.sync_id, self.scope, respond)
+
+    def __repr__(self):
+        return f"FetchData({self.sync_id!r}, {self.ranges!r})"
+
+
+class FetchOk(Reply):
+    __slots__ = ("sync_id", "ranges", "data")
+
+    def __init__(self, sync_id: TxnId, ranges: Ranges, data: Dict[object, Tuple]):
+        self.sync_id = sync_id
+        self.ranges = ranges  # which request this answers (a source can hold
+        self.data = data      # several outstanding fetches); key -> entries
+
+    def __repr__(self):
+        return f"FetchOk({self.sync_id!r}, keys={len(self.data)})"

@@ -89,6 +89,15 @@ def _read_one_store(store, txn_id: TxnId, txn: Txn, execute_at: Timestamp) -> As
 def execute_read_when_ready(node, txn_id: TxnId, txn: Txn, execute_at: Timestamp,
                             from_node, reply_context) -> None:
     stores = node.command_stores.intersecting(txn.keys)
+    read_keys = txn.read.keys() if txn.read is not None else None
+    if read_keys is not None:
+        # a bootstrapping replica must not serve reads from incomplete data
+        # (reference: CommandStore.safeToRead gating); the coordinator's
+        # ReadTracker escalates to another replica on the nack
+        for s in stores:
+            if not s.is_safe_to_read(s.owned(read_keys)):
+                node.reply(from_node, reply_context, ReadNack(txn_id))
+                return
     waits = [_read_one_store(s, txn_id, txn, execute_at) for s in stores]
 
     def merge(datas):

@@ -55,21 +55,72 @@ def build_topology(cfg: ClusterConfig, epoch: int = 1) -> Topology:
     return Topology(epoch, shards)
 
 
+class SimTopologyService:
+    """Cluster-global epoch authority (role-equivalent to the reference burn
+    test's BurnTestConfigurationService): owns the epoch sequence and delivers
+    every epoch to every node IN ORDER with random per-node delays, so nodes
+    learn topology changes asynchronously but never with gaps."""
+
+    def __init__(self, cluster: "Cluster", initial: Topology):
+        self.cluster = cluster
+        self.rng = cluster.rng.fork()
+        self.epochs = {initial.epoch: initial}
+        self._delivered: Dict[NodeId, int] = {}
+        self._delivering: set = set()
+
+    def latest(self) -> Topology:
+        return self.epochs[max(self.epochs)]
+
+    def delivered_topology(self, node_id: NodeId) -> Topology:
+        """The newest epoch this node has been handed (its 'current')."""
+        return self.epochs[self._delivered.get(node_id, 1)]
+
+    def mark_initial(self, node_id: NodeId) -> None:
+        self._delivered[node_id] = 1
+
+    def issue(self, topology: Topology) -> None:
+        assert topology.epoch == max(self.epochs) + 1, \
+            f"epoch gap: {topology.epoch} after {max(self.epochs)}"
+        self.epochs[topology.epoch] = topology
+        for node_id in list(self.cluster.nodes):
+            self._pump(node_id)
+
+    def request(self, node_id: NodeId) -> None:
+        self._pump(node_id)
+
+    def _pump(self, node_id: NodeId) -> None:
+        if node_id in self._delivering:
+            return
+        nxt = self._delivered.get(node_id, 1) + 1
+        if nxt not in self.epochs:
+            return
+        self._delivering.add(node_id)
+        topology = self.epochs[nxt]
+
+        def deliver():
+            self._delivering.discard(node_id)
+            self._delivered[node_id] = nxt
+            node = self.cluster.nodes.get(node_id)
+            if node is not None:
+                node.on_topology_update(topology)
+            self._pump(node_id)
+
+        self.cluster.queue.add(self.rng.next_int_between(1_000, 100_000), deliver)
+
+
 class SimConfigService(ConfigurationService):
-    def __init__(self, topology: Topology):
-        self._topologies = {topology.epoch: topology}
-        self._current = topology
+    def __init__(self, service: SimTopologyService, node_id: NodeId):
+        self._service = service
+        self._node_id = node_id
 
     def current_topology(self) -> Topology:
-        return self._current
+        return self._service.delivered_topology(self._node_id)
 
     def get_topology_for_epoch(self, epoch: int) -> Optional[Topology]:
-        return self._topologies.get(epoch)
+        return self._service.epochs.get(epoch)
 
-    def add(self, topology: Topology) -> None:
-        self._topologies[topology.epoch] = topology
-        if topology.epoch > self._current.epoch:
-            self._current = topology
+    def fetch_topology_for_epoch(self, epoch: int) -> None:
+        self._service.request(self._node_id)
 
 
 class SimAgent(Agent):
@@ -103,6 +154,7 @@ class Cluster:
         self.nodes: Dict[NodeId, Node] = {}
         self.stores: Dict[NodeId, ListStore] = {}
         self.progress_engines: Dict[NodeId, object] = {}
+        self.topology_service = SimTopologyService(self, self.topology)
         for node_id in range(1, self.config.num_nodes + 1):
             store = ListStore()
             progress_factory = None
@@ -113,10 +165,11 @@ class Cluster:
                     interval_ms=self.config.progress_interval_ms,
                     stall_ms=self.config.progress_stall_ms)
                 progress_factory = engine.log_for
+            self.topology_service.mark_initial(node_id)
             node = Node(
                 node_id,
                 message_sink=self.network.sink_for(node_id),
-                config_service=SimConfigService(self.topology),
+                config_service=SimConfigService(self.topology_service, node_id),
                 scheduler=self.scheduler,
                 agent=SimAgent(self, node_id),
                 rng=self.rng.fork(),
@@ -137,6 +190,14 @@ class Cluster:
     def node(self, node_id: NodeId) -> Node:
         return self.nodes[node_id]
 
+    def current_topology(self) -> Topology:
+        return self.topology_service.latest()
+
+    def issue_topology(self, topology: Topology) -> None:
+        """Publish a new epoch to the cluster (delivered per-node, in order,
+        with random delays)."""
+        self.topology_service.issue(topology)
+
     def any_node(self) -> Node:
         return self.nodes[self.rng.pick(sorted(self.nodes))]
 
@@ -154,8 +215,9 @@ class Cluster:
         """At quiescence every replica of a key must hold the same list;
         returns the authoritative map (and asserts convergence)."""
         out: Dict[object, tuple] = {}
+        final = self.current_topology()
         for node_id, store in self.stores.items():
-            owned = self.topology.ranges_for_node(node_id)
+            owned = final.ranges_for_node(node_id)
             for key, entries in store.data.items():
                 if not owned.contains_key(key):
                     continue

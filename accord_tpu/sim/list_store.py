@@ -49,6 +49,17 @@ class ListStore(api.DataStore):
     def snapshot(self, key) -> Tuple[int, ...]:
         return tuple(v for _, v in self.data.get(key, []))
 
+    def merge_entries(self, fetched: Dict[object, Tuple]) -> None:
+        """Union a bootstrap-fetched snapshot into local storage; entries are
+        (executeAt, value) pairs so the union is idempotent and order-free."""
+        for key, entries in fetched.items():
+            cur = self.data.setdefault(key, [])
+            existing = set(cur)
+            for e in entries:
+                if e not in existing:
+                    insort(cur, e)
+                    existing.add(e)
+
 
 class ListRead(api.Read):
     def __init__(self, keys: Keys):

@@ -108,7 +108,12 @@ class SimNetwork:
         # the send, and must never share live state with the sender
         payload = wire.encode(request) if self.serialize and src != dst else None
         ctx = ReplyContext(src, msg_id)
-        node = self.nodes[dst]
+        node = self.nodes.get(dst)
+        if node is None:
+            # destination down/not yet joined: behaves like a drop (the
+            # sender's timeout fires)
+            self.stats["dropped"] += 1
+            return
 
         def deliver():
             self._count("delivered")

@@ -52,6 +52,27 @@ class ReducingRangeMap(Generic[V]):
             i += 1
         return acc
 
+    def covers(self, start, end, pred: Callable[[V], bool]) -> bool:
+        """True when every point of [start, end) lies in a segment whose
+        non-None value satisfies pred (gaps fail)."""
+        if start >= end:
+            return True
+        if not self.bounds:
+            return False
+        i = bisect_right(self.bounds, start) - 1
+        if i < 0:
+            return False
+        pos = start
+        while pos < end:
+            if i >= len(self.values):
+                return False
+            seg_start, seg_end, v = self.bounds[i], self.bounds[i + 1], self.values[i]
+            if seg_start > pos or v is None or not pred(v):
+                return False
+            pos = seg_end
+            i += 1
+        return True
+
     def fold_values(self, fn: Callable[[Any, V], Any], acc):
         for v in self.values:
             if v is not None:

@@ -141,8 +141,11 @@ def test_esp_apply_advances_redundant_before():
         for store in n.command_stores.all():
             cmd = store.command_if_present(sp.sync_id)
             if cmd is not None and cmd.has_been(Status.APPLIED):
-                assert store.redundant_before.get(50) == sp.sync_id.as_timestamp() \
-                    or store.redundant_before.get(50000) == sp.sync_id.as_timestamp()
+                probes = [k for k in (50, 50000) if store.ranges.contains_key(k)]
+                if not probes:
+                    continue
+                assert any(store.redundant_before.get(k) == sp.sync_id.as_timestamp()
+                           for k in probes)
                 advanced += 1
     assert advanced > 0
 

@@ -1,0 +1,143 @@
+"""Topology change: epochs, handover sync, bootstrap + fetch.
+
+Mirrors the reference's elasticity machinery (topology/TopologyManager.java:71,
+local/CommandStores.java:646 updateTopology, local/Bootstrap.java:81,
+impl/AbstractFetchCoordinator.java:60): a new epoch that moves a range to a
+new replica must (a) keep coordinations contacting the old replica set until
+the epoch syncs, (b) have the new replica acquire the range's history before
+serving reads, and (c) converge.
+"""
+from __future__ import annotations
+
+import pytest
+
+from accord_tpu.local.status import Status
+from accord_tpu.primitives.keyspace import Keys, Range, Ranges
+from accord_tpu.primitives.timestamp import TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.sim.cluster import Cluster, ClusterConfig, build_topology
+from accord_tpu.sim.list_store import ListQuery, ListRead, ListUpdate
+from accord_tpu.topology.shard import Shard
+from accord_tpu.topology.topology import Topology
+
+
+def write_txn(keys: Keys, value: int) -> Txn:
+    return Txn(TxnKind.WRITE, keys, read=ListRead(keys),
+               update=ListUpdate(keys, value), query=ListQuery())
+
+
+def read_txn(keys: Keys) -> Txn:
+    return Txn(TxnKind.READ, keys, read=ListRead(keys), query=ListQuery())
+
+
+def four_node_cluster(seed: int) -> Cluster:
+    return Cluster(seed, ClusterConfig(num_nodes=4, rf=3))
+
+
+def move_shard(topology: Topology, shard_index: int, new_nodes) -> Topology:
+    """Next epoch with one shard's replica set replaced."""
+    shards = list(topology.shards)
+    old = shards[shard_index]
+    shards[shard_index] = Shard(old.range, list(new_nodes))
+    return Topology(topology.epoch + 1, shards)
+
+
+def test_epoch_sync_acks_retire_old_epoch():
+    cluster = four_node_cluster(seed=101)
+    t1 = cluster.current_topology()
+    # shard 0 is [0, 16384) on nodes (1, 2, 3); hand it to (2, 3, 4)
+    t2 = move_shard(t1, 0, (2, 3, 4))
+    cluster.issue_topology(t2)
+    cluster.drain()
+    cluster.check_no_failures()
+    for node in cluster.nodes.values():
+        assert node.topology_manager.has_epoch(2)
+        assert node.topology_manager.is_synced(2), \
+            f"node {node.id} never saw epoch 2 sync"
+
+
+def test_bootstrap_fetches_history_for_added_range():
+    cluster = four_node_cluster(seed=102)
+    node1 = cluster.nodes[1]
+    keys = Keys([10, 500, 12000])  # all in shard 0 = [0, 16384)
+    for v in (1, 2, 3):
+        node1.coordinate(write_txn(keys, v))
+    cluster.drain()
+    cluster.check_no_failures()
+
+    t2 = move_shard(cluster.current_topology(), 0, (2, 3, 4))
+    cluster.issue_topology(t2)
+    cluster.drain()
+    cluster.check_no_failures()
+
+    # node 4 (the new replica) must hold the full history
+    store4 = cluster.stores[4]
+    for k in keys:
+        assert store4.snapshot(k) == (1, 2, 3), \
+            f"node 4 missing history for {k}: {store4.snapshot(k)}"
+    # and its command stores must be safe to read the whole added range
+    for s in cluster.nodes[4].command_stores.all():
+        owned = s.current_owned()
+        assert s.safe_to_read.contains_ranges(owned)
+
+
+def test_new_replica_serves_consistent_reads():
+    cluster = four_node_cluster(seed=103)
+    keys = Keys([42])
+    for v in (1, 2):
+        cluster.nodes[1].coordinate(write_txn(keys, v))
+        cluster.drain()  # sequential: fixes the serialization order
+    cluster.check_no_failures()
+
+    t2 = move_shard(cluster.current_topology(), 0, (2, 3, 4))
+    cluster.issue_topology(t2)
+    cluster.drain()
+    cluster.check_no_failures()
+
+    # read coordinated AND served at the new replica
+    r = cluster.nodes[4].coordinate(read_txn(keys))
+    cluster.drain()
+    cluster.check_no_failures()
+    assert r.done and r.failure is None, f"read failed: {r.failure!r}"
+    assert r.value().reads[42] == (1, 2)
+
+
+def test_writes_across_handover_converge():
+    """Writes racing the topology change land on both replica sets and the
+    final owners converge on one history."""
+    cluster = four_node_cluster(seed=104)
+    keys = Keys([7, 9000])
+    results = []
+    for v in (1, 2):
+        results.append(cluster.nodes[1].coordinate(write_txn(keys, v)))
+    # issue the epoch while those writes are (possibly) in flight
+    t2 = move_shard(cluster.current_topology(), 0, (2, 3, 4))
+    cluster.issue_topology(t2)
+    for v in (3, 4):
+        results.append(cluster.nodes[2].coordinate(write_txn(keys, v)))
+    cluster.drain()
+    cluster.check_no_failures()
+    done = [r for r in results if r.done and r.failure is None]
+    assert len(done) >= 3  # racing the floor may invalidate a straggler
+    cluster.converged_key_lists()
+
+
+def test_rf_expansion_bootstraps_added_replica():
+    """Growing a shard from rf=2 to rf=3 bootstraps the new member."""
+    cluster = Cluster(105, ClusterConfig(num_nodes=3, rf=2))
+    keys = Keys([100])
+    cluster.nodes[1].coordinate(write_txn(keys, 9))
+    cluster.drain()
+    cluster.check_no_failures()
+    t1 = cluster.current_topology()
+    shard0 = t1.shards[0]
+    assert 100 in range(shard0.range.start, shard0.range.end) or \
+        shard0.range.contains(100)
+    new_nodes = sorted(set(shard0.nodes) | {3})
+    shards = list(t1.shards)
+    shards[0] = Shard(shard0.range, new_nodes)
+    cluster.issue_topology(Topology(2, shards))
+    cluster.drain()
+    cluster.check_no_failures()
+    assert cluster.stores[3].snapshot(100) == (9,)
+    cluster.converged_key_lists()
