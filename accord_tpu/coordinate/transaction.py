@@ -98,11 +98,31 @@ class CoordinateTransaction:
                 # CoordinateTransaction.java:87-89)
                 self._invalidate_rejected()
                 return
-            Invariants.check_state(
-                self.execute_at.epoch == self.txn_id.epoch or
-                self.node.topology_manager.has_epoch(self.execute_at.epoch),
-                "executeAt epoch %s unknown", self.execute_at.epoch)
+            self._maybe_extend_epochs()
+
+    def _maybe_extend_epochs(self) -> None:
+        """ExtraEpochs: a slow-path executeAt in a newer epoch means the new
+        epoch's replicas must witness the txn before Accept, and every later
+        round must span both replica sets (reference:
+        AbstractCoordinatePreAccept.ExtraEpochs, coordinate/
+        AbstractCoordinatePreAccept.java:211-238). Loops if the extra round
+        pushes executeAt into a yet-newer epoch."""
+        target = self.execute_at.epoch
+        if target <= self.topologies.current_epoch():
             self._start_propose()
+            return
+
+        def cont():
+            prev_max = self.topologies.current_epoch()
+            self.topologies = self.node.topology_manager.with_unsynced_epochs(
+                self.route, self.txn_id.epoch, target)
+            extra = self.node.topology_manager.precise_epochs(prev_max + 1, target)
+            round_ = _ExtraEpochsRound(self, extra)
+            for to in round_.tracker.nodes():
+                self.node.send(to, PreAccept(self.txn_id, self.txn, self.route,
+                                             min_epoch=target), round_)
+
+        self.node.with_epoch(target, cont)
 
     def _invalidate_rejected(self) -> None:
         """proposeAndCommitInvalidate at the original coordinator's ballot
@@ -203,6 +223,46 @@ class _PreAcceptRound(Callback):
         elif status == RequestStatus.FAILED:
             self.parent._fail(Preempted(str(self.parent.txn_id)) if self.nacked
                               else Timeout(f"preaccept {self.parent.txn_id}"))
+
+
+class _ExtraEpochsRound(Callback):
+    """PreAccept re-contact of the replicas added by epochs
+    (prev_max, executeAt.epoch] (reference: ExtraEpochs.contact)."""
+
+    def __init__(self, parent: CoordinateTransaction, extra_topologies):
+        self.parent = parent
+        self.tracker = QuorumTracker(extra_topologies, parent.txn.keys)
+        self.oks: Dict[int, PreAcceptOk] = {}
+        self.nacked = False
+
+    def on_success(self, from_node, reply) -> None:
+        if self.parent.done or self.tracker.decided is not None:
+            return
+        if isinstance(reply, PreAcceptNack):
+            self.nacked = True
+            self._handle(self.tracker.on_failure(from_node))
+            return
+        self.oks[from_node] = reply
+        self._handle(self.tracker.on_success(from_node))
+
+    def on_failure(self, from_node, failure) -> None:
+        if self.parent.done or self.tracker.decided is not None:
+            return
+        self._handle(self.tracker.on_failure(from_node))
+
+    def _handle(self, status: RequestStatus) -> None:
+        p = self.parent
+        if status == RequestStatus.SUCCESS:
+            p.execute_at = _merge_witnessed_all(
+                [p.execute_at] + [ok.witnessed_at for ok in self.oks.values()])
+            p.deps = Deps.merge([p.deps] + [ok.deps for ok in self.oks.values()])
+            if p.execute_at.is_rejected:
+                p._invalidate_rejected()
+            else:
+                p._maybe_extend_epochs()
+        elif status == RequestStatus.FAILED:
+            p._fail(Preempted(str(p.txn_id)) if self.nacked
+                    else Timeout(f"preaccept-extra {p.txn_id}"))
 
 
 class _ProposeRound(Callback):

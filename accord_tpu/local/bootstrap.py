@@ -25,7 +25,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Set
 
 from accord_tpu.messages.base import Callback
-from accord_tpu.messages.fetch import FetchData, FetchOk
+from accord_tpu.messages.fetch import FetchData, FetchNack, FetchOk
 from accord_tpu.primitives.keyspace import Ranges
 from accord_tpu.primitives.timestamp import NodeId
 from accord_tpu.utils.async_ import AsyncResult, success
@@ -42,6 +42,7 @@ class Bootstrap:
         self.ranges = ranges
         self.result: AsyncResult = AsyncResult()
         self.attempt = 0
+        self.aborted = False
 
     @classmethod
     def run(cls, node, store, epoch: int, ranges: Ranges) -> AsyncResult:
@@ -50,13 +51,34 @@ class Bootstrap:
             store.mark_safe_to_read(ranges)
             return success(None)
         self = cls(node, store, epoch, ranges)
+        # until the snapshot arrives this store's data for `ranges` has a
+        # gap: it must not serve fetches for them (FetchData nacks)
+        store.mark_gap(ranges)
+        store.active_bootstraps.append(self)
         self._start()
         return self.result
+
+    def abort(self) -> None:
+        """A later epoch removed (some of) these ranges before the snapshot
+        arrived: stop. The data gap REMAINS marked -- this store's history
+        for the ranges is genuinely incomplete, and only a future successful
+        bootstrap may clear it (reference: Bootstrap invalidation on topology
+        change, local/Bootstrap.java:81)."""
+        if self.aborted:
+            return
+        self.aborted = True
+        if self in self.store.active_bootstraps:
+            self.store.active_bootstraps.remove(self)
+        # release the epoch-sync waiter: the obligation for removed ranges is
+        # moot (a still-owned remainder is re-bootstrapped by the caller)
+        self.result.try_set_success(None)
 
     # -- step 1+2: the ExclusiveSyncPoint ------------------------------------
     def _start(self) -> None:
         from accord_tpu.coordinate.syncpoint import CoordinateSyncPoint
         from accord_tpu.primitives.timestamp import TxnKind
+        if self.aborted:
+            return
         self.attempt += 1
         sp = CoordinateSyncPoint.build(self.node, TxnKind.EXCLUSIVE_SYNC_POINT,
                                        self.ranges)
@@ -68,6 +90,8 @@ class Bootstrap:
             .on_failure(lambda f: self._retry("sync_point", f))
 
     def _retry(self, phase: str, failure) -> None:
+        if self.aborted:
+            return
         # one retry per failure, whoever fires first (the agent's callback or
         # our backoff timer) -- never two concurrent bootstraps of the ranges
         token = object()
@@ -85,13 +109,20 @@ class Bootstrap:
 
     # -- step 3: fetch from the prior epoch's replicas -----------------------
     def _fetch(self, sync_point) -> None:
+        if self.aborted:
+            return
         prev = self.node.topology_manager.for_epoch(self.epoch - 1)
         fetch = _FetchRound(self, sync_point, prev)
         fetch.start()
 
     # -- step 4 --------------------------------------------------------------
     def _finish(self, merged: Dict) -> None:
+        if self.aborted:
+            return
         self.node.data_store.merge_entries(merged)
+        if self in self.store.active_bootstraps:
+            self.store.active_bootstraps.remove(self)
+        self.store.fill_gap(self.ranges)
         self.store.mark_safe_to_read(self.ranges)
         self.result.try_set_success(None)
 
@@ -134,7 +165,13 @@ class _FetchRound(Callback):
                                self.sync_point.seekables, ranges), self)
 
     def on_success(self, from_node, reply) -> None:
-        if self.failed or not isinstance(reply, FetchOk):
+        if self.failed or self.parent.aborted:
+            return
+        if isinstance(reply, FetchNack):
+            self.on_failure(from_node, RuntimeError(
+                f"source {from_node} bootstrap pending for {reply.ranges}"))
+            return
+        if not isinstance(reply, FetchOk):
             return
         for key, entries in reply.data.items():
             self.merged.setdefault(key, set()).update(entries)
@@ -152,7 +189,7 @@ class _FetchRound(Callback):
             self.parent._finish(self.merged)
 
     def on_failure(self, from_node, failure) -> None:
-        if self.failed:
+        if self.failed or self.parent.aborted:
             return
         retry = []
         for entry in self.outstanding.pop(from_node, ()):

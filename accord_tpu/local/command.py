@@ -56,7 +56,7 @@ class Command:
     __slots__ = (
         "txn_id", "status", "durability", "promised", "accepted_ballot",
         "execute_at", "txn", "route", "deps", "writes", "result",
-        "waiting_on", "waiters", "transient_listeners",
+        "waiting_on", "waiters", "transient_listeners", "elision_floor_cache",
     )
 
     def __init__(self, txn_id: TxnId):
@@ -75,6 +75,8 @@ class Command:
         # commands in the same store whose WaitingOn includes us
         self.waiters: Set[TxnId] = set()
         self.transient_listeners: List[TransientListener] = []
+        # (bootstrapped_at map identity, floor) memo for dep elision
+        self.elision_floor_cache = None
 
     # -- knowledge predicates (the reference's Known vector) ----------------
     def has_been(self, status: Status) -> bool:

@@ -320,7 +320,11 @@ def maybe_execute(store: CommandStore, cmd: Command) -> None:
 
 def _do_apply(store: CommandStore, cmd: Command) -> None:
     if cmd.writes is not None:
-        cmd.writes.apply_to(store, store.ranges)
+        # pre-bootstrap gating (reference: Commands.applyChain consulting
+        # RedundantBefore PRE_BOOTSTRAP status): a txn ordered below this
+        # store's bootstrap floor had its effects delivered by the fetched
+        # snapshot; re-applying here would double-write
+        cmd.writes.apply_to(store, store.apply_ranges_for(cmd.txn_id))
     cmd.status = Status.APPLIED
     if cmd.txn_id.kind is TxnKind.EXCLUSIVE_SYNC_POINT:
         # every conflicting txn below the ESP has now applied locally

@@ -38,7 +38,8 @@ class Node:
                  agent: Agent, rng: RandomSource, time_service: TimeService,
                  data_store, num_stores: int = 1,
                  progress_log_factory: Optional[Callable] = None,
-                 deps_resolver=None, events: Optional[EventsListener] = None):
+                 deps_resolver=None, deps_batch_window_ms: Optional[float] = 0.0,
+                 events: Optional[EventsListener] = None):
         self.id = node_id
         self.message_sink = message_sink
         self.config_service = config_service
@@ -52,6 +53,9 @@ class Node:
         self._num_stores = num_stores
         self._progress_log_factory = progress_log_factory
         self._deps_resolver = deps_resolver
+        # micro-batch coalescing window for the device deps path (None =
+        # inline, no deferral; see CommandStore.submit_preaccept)
+        self.deps_batch_window_ms = deps_batch_window_ms
         self.command_stores: Optional[CommandStores] = None
         # HLC state (reference: Node.uniqueNow CAS loop, local/Node.java:348)
         self._last_hlc = 0

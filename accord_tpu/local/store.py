@@ -60,6 +60,17 @@ class CommandStore:
         self.progress_log = (progress_log_factory(self) if progress_log_factory
                              else _NoopProgressLog())
         self.deps_resolver = deps_resolver  # None -> host scan below
+        # micro-batch tick state (SURVEY section-7 host<->device engineering):
+        # PreAccepts queue here and drain through ONE batched max-conflict +
+        # ONE batched deps kernel call per tick
+        self._preaccept_queue: list = []
+        self._tick_scheduled = False
+        self._mc_override: Optional[Dict[TxnId, Optional[Timestamp]]] = None
+        # 0.0 = coalesce same-scheduler-turn arrivals; None = inline (no
+        # deferral -- bit-identical timing with the host path, used by the
+        # differential tests)
+        self.batch_window_ms: Optional[float] = getattr(
+            node, "deps_batch_window_ms", 0.0)
         # ExclusiveSyncPoint floor machinery (reference:
         # local/CommandStore.java:301-317 + RedundantBefore.java:49):
         #   reject_before  -- set at ESP *preaccept*: any later-arriving txn
@@ -75,6 +86,14 @@ class CommandStore:
         # RedundantBefore.bootstrappedAt): deps below it within bootstrapped
         # ranges were covered by the fetched snapshot -- never waited on
         self.bootstrapped_at: ReducingRangeMap = ReducingRangeMap.EMPTY
+        # ranges where this store's data has an unfilled gap: a bootstrap
+        # floor was set but its snapshot has not arrived (or the bootstrap
+        # was aborted by a later removal). The store must not serve fetches
+        # for them -- dep elision + a missing snapshot would hand a fetcher
+        # stale data. Cleared only when a bootstrap's snapshot merges.
+        self.data_gaps: Ranges = Ranges.EMPTY
+        # bootstraps currently acquiring ranges for this store
+        self.active_bootstraps: list = []
 
     # -- execution context ---------------------------------------------------
     def execute(self, fn: Callable[["CommandStore"], None]) -> AsyncResult:
@@ -128,17 +147,10 @@ class CommandStore:
         return self._owned_by_epoch[max(self._owned_by_epoch)]
 
     def mark_safe_to_read(self, ranges: Ranges) -> None:
+        """Bookkeeping of completed acquisitions (asserted by tests). Reads
+        gate on data GAPS (has_gap -- a replica that merely lost a range can
+        still serve; one awaiting a snapshot cannot), not on this set."""
         self.safe_to_read = self.safe_to_read.union(ranges)
-
-    def clear_safe_to_read(self, ranges: Ranges) -> None:
-        self.safe_to_read = self.safe_to_read.difference(ranges)
-
-    def is_safe_to_read(self, seekables: Seekables) -> bool:
-        """Every owned part of `seekables` must be within the safe set."""
-        owned = self.owned(seekables)
-        if isinstance(owned, Keys):
-            return all(self.safe_to_read.contains_key(k) for k in owned)
-        return self.safe_to_read.contains_ranges(_as_ranges(owned))
 
     # -- ownership -----------------------------------------------------------
     def owns(self, seekables: Seekables) -> bool:
@@ -195,12 +207,44 @@ class CommandStore:
             # an ESP always witnesses at its own id: it has no executeAt of
             # its own, and marking the reject floor happened at registration
             return txn_id
-        min_non_conflicting = self.max_conflict_ts(seekables)
+        min_non_conflicting = self._max_conflict_resolved(txn_id, seekables)
         if (permit_fast_path
                 and (min_non_conflicting is None or txn_id >= min_non_conflicting)
                 and txn_id.epoch >= self.node.epoch):
             return txn_id
         return self.node.unique_now(min_non_conflicting or txn_id)
+
+    def _max_conflict_resolved(self, txn_id: TxnId,
+                               seekables: Seekables) -> Optional[Timestamp]:
+        """Max-conflict via the device kernel when a resolver is installed
+        (merged with the host range map, which tracks range-domain txns);
+        host scan otherwise. During a batch tick the per-subject result was
+        precomputed by ONE batched kernel call and is injected here."""
+        if self._mc_override is not None and txn_id in self._mc_override:
+            handled, ts = self._mc_override[txn_id]
+            if handled:
+                return self._merge_range_map_conflicts(ts, seekables)
+            return self.max_conflict_ts(seekables)  # collision: host decides
+        if self.deps_resolver is not None:
+            handled, device_max = self.deps_resolver.max_conflict(
+                self, txn_id, seekables)
+            if handled:
+                return self._merge_range_map_conflicts(device_max, seekables)
+        return self.max_conflict_ts(seekables)
+
+    def _merge_range_map_conflicts(self, out: Optional[Timestamp],
+                                   seekables: Seekables) -> Optional[Timestamp]:
+        """Fold the host range map (range-domain registrations, which the
+        device active set does not mirror) into a device max-conflict."""
+        if not self.max_conflicts.is_empty():
+            if isinstance(seekables, Keys):
+                for k in seekables:
+                    out = Timestamp.merge_max(out, self.max_conflicts.get(k))
+            else:
+                for r in seekables:
+                    out = self.max_conflicts.fold_over_range(
+                        r.start, r.end, Timestamp.merge_max, out)
+        return out
 
     def _rejects(self, txn_id: TxnId, seekables: Seekables) -> bool:
         """Reject-before fold + expiry (reference: CommandStore.preaccept
@@ -269,24 +313,77 @@ class CommandStore:
                 self.node.scheduler.once(
                     0.0, lambda c=cmd: _commands.maybe_execute(self, c))
 
+    def mark_gap(self, ranges: Ranges) -> None:
+        self.data_gaps = self.data_gaps.union(ranges)
+
+    def fill_gap(self, ranges: Ranges) -> None:
+        self.data_gaps = self.data_gaps.difference(ranges)
+
+    def has_gap(self, ranges: Ranges) -> bool:
+        return self.data_gaps.intersects(ranges)
+
+    def apply_ranges_for(self, txn_id: TxnId) -> Ranges:
+        """The sub-ranges of this store where `txn_id`'s writes must actually
+        be applied: everything except ranges whose bootstrap floor is above
+        the txn (there, the fetched snapshot already delivered its effects;
+        reference: RedundantBefore.PRE_BOOTSTRAP gating in Commands.apply)."""
+        if self.bootstrapped_at.is_empty():
+            return self.ranges
+        ts = txn_id.as_timestamp()
+        out: Ranges = Ranges.EMPTY
+        for r in self.ranges:
+            # keep the parts of r NOT floored above ts
+            floored = Ranges(Range(s, e) for s, e in
+                             self.bootstrapped_at.segments_where(
+                                 r.start, r.end, lambda f: ts < f))
+            out = out.union(Ranges([r]).difference(floored))
+        return out
+
     def dep_elided_by_floor(self, cmd, dep_id: TxnId) -> bool:
         """True when the dep's effects came with a bootstrap snapshot, so it
         will never individually apply here. A dep gates the waiter only
         through keys both own in this store; if EVERY owned key of the waiter
         is floored above the dep, every shared key is too -- safe to elide."""
+        floor = self.elision_floor(cmd)
+        return floor is not None and dep_id.as_timestamp() < floor
+
+    def elision_floor(self, cmd) -> Optional[Timestamp]:
+        """min bootstrap floor over the waiter's owned keys (None when any
+        owned key is unfloored): deps strictly below it are elided. Cached on
+        the command, invalidated when the floor map advances."""
         if self.bootstrapped_at.is_empty() or cmd.txn is None:
-            return False
-        ts = dep_id.as_timestamp()
+            return None
+        cached = cmd.elision_floor_cache
+        if cached is not None and cached[0] is self.bootstrapped_at \
+                and cached[1] is cmd.txn and cached[2] is self._owned_union:
+            return cached[3]
+        floor = self._compute_elision_floor(cmd)
+        cmd.elision_floor_cache = (self.bootstrapped_at, cmd.txn,
+                                   self._owned_union, floor)
+        return floor
+
+    def _compute_elision_floor(self, cmd) -> Optional[Timestamp]:
         owned = self.owned(cmd.txn.keys)
+        out: Optional[Timestamp] = None
         if isinstance(owned, Keys):
             if len(owned) == 0:
-                return False
-            return all((f := self.bootstrapped_at.get(k)) is not None and ts < f
-                       for k in owned)
+                return None
+            for k in owned:
+                f = self.bootstrapped_at.get(k)
+                if f is None:
+                    return None
+                out = f if out is None or f < out else out
+            return out
         if owned.is_empty():
-            return False
-        return all(self.bootstrapped_at.covers(r.start, r.end, lambda f: ts < f)
-                   for r in _as_ranges(owned))
+            return None
+        # every point of every owned range must be floored; take the min
+        for r in _as_ranges(owned):
+            if not self.bootstrapped_at.covers(r.start, r.end, lambda f: True):
+                return None
+            out = self.bootstrapped_at.fold_over_range(
+                r.start, r.end,
+                lambda acc, f: f if acc is None or f < acc else acc, out)
+        return out
 
     def is_rejected_if_not_preaccepted(self, txn_id: TxnId,
                                        seekables: Seekables) -> bool:
@@ -315,6 +412,108 @@ class CommandStore:
             return self.deps_resolver.resolve_one(self, txn_id, seekables, before)
         return self.host_calculate_deps(txn_id, seekables, before)
 
+    # -- the micro-batched PreAccept path ------------------------------------
+    def submit_preaccept(self, txn_id: TxnId, partial_txn, route,
+                         ballot=None) -> AsyncResult:
+        """PreAccept against this store. With a batch resolver installed,
+        subjects queue and drain through a per-store tick: ONE batched
+        max-conflict kernel call decides every witnessed timestamp, then ONE
+        batched deps kernel call computes every deps set (SURVEY section 7:
+        amortizing the host<->device round trip over the micro-batch).
+        Completes with (outcome, witnessed_at, deps)."""
+        from accord_tpu.local import commands
+        from accord_tpu.primitives.timestamp import Ballot
+        ballot = ballot or Ballot.ZERO
+        resolver = self.deps_resolver
+        if resolver is None or not hasattr(resolver, "max_conflict_batch") \
+                or not isinstance(partial_txn.keys, Keys) \
+                or self.batch_window_ms is None:
+            return success(self._preaccept_now(txn_id, partial_txn, route, ballot))
+        out = AsyncResult()
+        self._preaccept_queue.append((txn_id, partial_txn, route, ballot, out))
+        if not self._tick_scheduled:
+            self._tick_scheduled = True
+            self.node.scheduler.once(self.batch_window_ms, self._preaccept_tick)
+        return out
+
+    def _preaccept_now(self, txn_id, partial_txn, route, ballot):
+        from accord_tpu.local import commands
+        outcome = commands.preaccept(self, txn_id, partial_txn, route, ballot)
+        from accord_tpu.local.commands import AcceptOutcome
+        if outcome in (AcceptOutcome.REJECTED_BALLOT, AcceptOutcome.TRUNCATED):
+            return (outcome, None, None)
+        witnessed = self.command(txn_id).execute_at
+        deps = self.calculate_deps(txn_id, self.owned(partial_txn.keys), witnessed)
+        return (outcome, witnessed, deps)
+
+    def _preaccept_tick(self) -> None:
+        from accord_tpu.local import commands
+        from accord_tpu.local.commands import AcceptOutcome
+        self._tick_scheduled = False
+        batch, self._preaccept_queue = self._preaccept_queue, []
+        if not batch:
+            return
+        # phase 1: one batched max-conflict for every queued subject
+        # (handled=False = bucket collision: the host scan decides, recorded
+        # so _max_conflict_resolved skips a redundant 1-subject device call)
+        mc = self.deps_resolver.max_conflict_batch(
+            self, [(t, self.owned(p.keys)) for t, p, _, _, _ in batch])
+        self._mc_override = {t: res for (t, p, _, _, _), res in zip(batch, mc)}
+        phase1 = []
+        try:
+            # phase 2: host preaccept logic per subject, injected max-conflict;
+            # registrations append to the device active set incrementally, so
+            # batchmates witness each other in phase 3 (valid: deps may be any
+            # conservative superset; execution still orders by executeAt)
+            for (t, p, route, ballot, out) in batch:
+                try:
+                    outcome = commands.preaccept(self, t, p, route, ballot)
+                except BaseException as e:  # noqa: BLE001
+                    # never strand the batchmates: fail THIS subject's reply
+                    # like the inline path would, keep draining the rest
+                    out.try_set_failure(e)
+                    phase1.append((t, p, None, None, None))
+                    continue
+                if outcome in (AcceptOutcome.REJECTED_BALLOT,
+                               AcceptOutcome.TRUNCATED):
+                    phase1.append((t, p, outcome, None, out))
+                else:
+                    phase1.append((t, p, outcome,
+                                   self.command(t).execute_at, out))
+        finally:
+            self._mc_override = None
+        # phase 3: one batched deps resolve for the accepted subjects
+        subjects = [(t, self.owned(p.keys), w)
+                    for (t, p, oc, w, _) in phase1 if w is not None]
+        rows = self.deps_resolver.resolve_batch(self, subjects) if subjects else []
+        need_host_ranges = bool(self.range_txns)
+        it = iter(rows)
+        for (t, p, oc, w, out) in phase1:
+            if out is None:
+                continue  # failed in phase 2; reply already failed
+            if w is None:
+                out.try_set_success((oc, None, None))
+                continue
+            deps = next(it)
+            if need_host_ranges:
+                deps = deps.union(self.host_range_deps(
+                    t, self.owned(p.keys), w))
+            out.try_set_success((oc, w, deps))
+
+    def host_range_deps(self, txn_id: TxnId, seekables: Seekables,
+                        before: Timestamp) -> Deps:
+        """Only the range-domain conflicts (the device path computes key-domain
+        deps exactly; range txns are tracked host-side and unioned in)."""
+        kb = KeyDepsBuilder()
+        kind = txn_id.kind
+        Invariants.check_argument(isinstance(seekables, Keys))
+        for k in self.owned_keys(seekables):
+            for rid, rranges in self.range_txns.items():
+                if rid != txn_id and rid < before and kind.witnesses(rid.kind) \
+                        and rranges.contains_key(k):
+                    kb.add(k, rid)
+        return Deps(kb.build())
+
     def host_calculate_deps(self, txn_id: TxnId, seekables: Seekables,
                             before: Timestamp) -> Deps:
         kb = KeyDepsBuilder()
@@ -326,11 +525,9 @@ class CommandStore:
                 if c is not None:
                     for dep in c.conflicts_before(txn_id, before):
                         kb.add(k, dep)
-                # range txns intersecting this key also conflict
-                for rid, rranges in self.range_txns.items():
-                    if rid != txn_id and rid < before and kind.witnesses(rid.kind) \
-                            and rranges.contains_key(k):
-                        kb.add(k, rid)
+            # range txns intersecting these keys also conflict
+            return Deps(kb.build(), rb.build()).union(
+                self.host_range_deps(txn_id, seekables, before))
         else:
             owned = seekables.slice(self.ranges)
             # key txns within the ranges
@@ -423,6 +620,11 @@ class CommandStore:
                 prev = self.range_txns.get(txn_id)
                 self.range_txns[txn_id] = prev.union(owned) if prev else owned
         self.update_max_conflicts(owned, witnessed_at)
+        if self.deps_resolver is not None:
+            # incremental device active-set maintenance (append/lane update,
+            # no re-encode): the whole TPU data plane hangs off this funnel
+            self.deps_resolver.on_register(self, txn_id, owned, status,
+                                           witnessed_at)
 
 
 def _as_ranges(seekables: Seekables) -> Ranges:

@@ -73,10 +73,19 @@ class CommandStores:
             new_owned = owned.intersection(s.slice_ranges)
             added, removed = s.set_owned(topology.epoch, new_owned)
             if not removed.is_empty():
-                # a removed range's history goes stale here the moment the
-                # new owners take writes; if it ever comes back, only a fresh
-                # bootstrap may re-mark it safe
-                s.clear_safe_to_read(removed)
+                # a removed range's data stays SERVABLE here (complete below
+                # the handover; reads gate on readiness + data gaps, not
+                # ownership) -- but if the range ever comes back, re-adding
+                # triggers a fresh bootstrap below.
+                # in-flight bootstraps for removed ranges are moot: abort them
+                # (their data gap stays marked); any still-owned remainder is
+                # re-acquired under this epoch
+                for b in [b for b in s.active_bootstraps
+                          if b.ranges.intersects(removed)]:
+                    b.abort()
+                    remainder = b.ranges.intersection(new_owned)
+                    if not remainder.is_empty():
+                        pending.append(self._bootstrap(s, topology.epoch, remainder))
             if not added.is_empty():
                 pending.append(self._bootstrap(s, topology.epoch, added))
         if not pending:

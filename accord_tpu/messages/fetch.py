@@ -30,6 +30,16 @@ class FetchData(Request):
 
     def process(self, node, from_node, reply_context) -> None:
         def respond():
+            # a source whose data has a gap over any of these ranges (its
+            # bootstrap snapshot never arrived, so its floor elided pre-floor
+            # deps without the history being present) must not serve: refuse
+            # so the fetcher tries another source (reference: ReadData
+            # replies with unavailable ranges)
+            for s in node.command_stores.all():
+                if s.has_gap(self.ranges):
+                    node.reply(from_node, reply_context,
+                               FetchNack(self.sync_id, self.ranges))
+                    return
             data: Dict[object, Tuple] = {}
             for key, entries in node.data_store.data.items():
                 if self.ranges.contains_key(key):
@@ -41,6 +51,20 @@ class FetchData(Request):
 
     def __repr__(self):
         return f"FetchData({self.sync_id!r}, {self.ranges!r})"
+
+
+class FetchNack(Reply):
+    """Source cannot serve these ranges right now (its own bootstrap of them
+    is incomplete); the fetcher escalates to another source."""
+
+    __slots__ = ("sync_id", "ranges")
+
+    def __init__(self, sync_id: TxnId, ranges: Ranges):
+        self.sync_id = sync_id
+        self.ranges = ranges
+
+    def __repr__(self):
+        return f"FetchNack({self.sync_id!r}, {self.ranges!r})"
 
 
 class FetchOk(Reply):

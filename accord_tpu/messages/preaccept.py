@@ -14,36 +14,52 @@ from accord_tpu.primitives.txn import Txn
 
 
 class PreAccept(Request):
-    def __init__(self, txn_id: TxnId, txn: Txn, route: Route):
+    def __init__(self, txn_id: TxnId, txn: Txn, route: Route,
+                 min_epoch: int = 0):
         self.txn_id = txn_id
         self.txn = txn
         self.route = route
-        self.wait_for_epoch = txn_id.epoch
+        # ExtraEpochs re-contact must not process before the recipient has
+        # the epoch whose replicas it is addressed to (reference:
+        # TxnRequest computes waitForEpoch from the scope epochs)
+        self.wait_for_epoch = max(txn_id.epoch, min_epoch)
 
     def process(self, node, from_node, reply_context) -> None:
-        def map_fn(store):
-            partial = self.txn.slice(store.ranges, include_query=False)
-            outcome = commands.preaccept(store, self.txn_id, partial, self.route)
-            if outcome == AcceptOutcome.REJECTED_BALLOT:
-                return PreAcceptNack(self.txn_id)
-            if outcome == AcceptOutcome.TRUNCATED:
-                return PreAcceptNack(self.txn_id)
-            cmd = store.command(self.txn_id)
-            witnessed = cmd.execute_at
-            deps = store.calculate_deps(self.txn_id, store.owned(self.txn.keys), witnessed)
-            return PreAcceptOk(self.txn_id, witnessed, deps)
+        from accord_tpu.utils.async_ import all_of, success
 
-        def reduce_fn(a, b):
-            if isinstance(a, PreAcceptNack) or isinstance(b, PreAcceptNack):
-                return a if isinstance(a, PreAcceptNack) else b
-            # (reference: PreAcceptOk reduce, messages/PreAccept.java:141-156;
-            # merge_witnessed keeps one store's rejection sticky across stores)
-            return PreAcceptOk(self.txn_id,
-                               Timestamp.merge_witnessed(a.witnessed_at, b.witnessed_at),
-                               a.deps.union(b.deps))
+        stores = node.command_stores.intersecting(self.txn.keys)
+        if not stores:
+            node.reply(from_node, reply_context, None)
+            return
+        # per-store PreAccept, micro-batched onto the device when a batch
+        # resolver is installed (store.submit_preaccept)
+        parts = [s.submit_preaccept(
+                    self.txn_id, self.txn.slice(s.ranges, include_query=False),
+                    self.route)
+                 for s in stores]
 
-        node.command_stores.map_reduce(self.txn.keys, map_fn, reduce_fn) \
-            .on_success(lambda reply: node.reply(from_node, reply_context, reply)) \
+        def finish(results):
+            reply = None
+            for outcome, witnessed, deps in results:
+                if outcome in (AcceptOutcome.REJECTED_BALLOT,
+                               AcceptOutcome.TRUNCATED):
+                    reply = PreAcceptNack(self.txn_id)
+                    break
+                part = PreAcceptOk(self.txn_id, witnessed, deps)
+                if reply is None:
+                    reply = part
+                else:
+                    # (reference: PreAcceptOk reduce, messages/PreAccept.java:
+                    # 141-156; merge_witnessed keeps one store's rejection
+                    # sticky across stores)
+                    reply = PreAcceptOk(
+                        self.txn_id,
+                        Timestamp.merge_witnessed(reply.witnessed_at,
+                                                  part.witnessed_at),
+                        reply.deps.union(part.deps))
+            node.reply(from_node, reply_context, reply)
+
+        all_of(parts).on_success(finish) \
             .on_failure(node.agent.on_uncaught_exception)
 
     def __repr__(self):

@@ -91,11 +91,16 @@ def execute_read_when_ready(node, txn_id: TxnId, txn: Txn, execute_at: Timestamp
     stores = node.command_stores.intersecting(txn.keys)
     read_keys = txn.read.keys() if txn.read is not None else None
     if read_keys is not None:
-        # a bootstrapping replica must not serve reads from incomplete data
-        # (reference: CommandStore.safeToRead gating); the coordinator's
-        # ReadTracker escalates to another replica on the nack
+        # a replica with a data GAP over the read must not serve: its
+        # bootstrap snapshot never arrived, so deps below its floor were
+        # elided without the history being present (reference:
+        # CommandStore.safeToRead gating). A replica that merely LOST the
+        # range can still serve -- its data below the handover is complete,
+        # and readiness (deps applied) guarantees the snapshot at executeAt.
+        # The coordinator's ReadTracker escalates to another replica on nack.
         for s in stores:
-            if not s.is_safe_to_read(s.owned(read_keys)):
+            owned = s.owned(read_keys)
+            if len(owned) > 0 and s.has_gap(owned.to_ranges()):
                 node.reply(from_node, reply_context, ReadNack(txn_id))
                 return
     waits = [_read_one_store(s, txn_id, txn, execute_at) for s in stores]

@@ -69,11 +69,18 @@ class TimestampEncoder:
 
 def encode_key_bitmaps(key_sets: Sequence[Sequence[int]], num_buckets: int) -> np.ndarray:
     """-> float bitmap [len(key_sets), num_buckets] with 1.0 where the txn
-    touches a key hashing to that bucket (float for MXU matmul)."""
-    out = np.zeros((len(key_sets), num_buckets), dtype=np.float32)
-    for i, keys in enumerate(key_sets):
-        for k in keys:
-            out[i, int(k) % num_buckets] = 1.0
+    touches a key hashing to that bucket (float for MXU matmul). Vectorized
+    NumPy scatter -- one fancy-index assignment, no per-key Python loop."""
+    n = len(key_sets)
+    out = np.zeros((n, num_buckets), dtype=np.float32)
+    counts = np.fromiter((len(ks) for ks in key_sets), dtype=np.int64, count=n)
+    total = int(counts.sum())
+    if total == 0:
+        return out
+    rows = np.repeat(np.arange(n), counts)
+    cols = np.fromiter((int(k) for ks in key_sets for k in ks),
+                       dtype=np.int64, count=total) % num_buckets
+    out[rows, cols] = 1.0
     return out
 
 

@@ -60,21 +60,20 @@ def deps_matrix(subj_bitmaps, subj_before, subj_kinds,
 
 
 @functools.partial(jax.jit, static_argnames=())
-def max_conflict(subj_bitmaps, subj_kinds, act_bitmaps, act_exec_ts,
-                 act_kinds, act_valid, witness_table):
+def max_conflict(subj_bitmaps, act_bitmaps, act_exec_ts, act_valid):
     """Max witnessed-conflict timestamp per subject (feeds the fast-path test
     txnId >= maxConflicts; reference: MaxConflicts + CommandStore.preaccept).
+    Kind-agnostic, like the reference's MaxConflicts: ANY registered txn on a
+    shared key raises the timestamp floor.
 
     act_exec_ts: i32[A, 3] -- max(executeAt, txnId) per active txn.
-    -> i32[B, 3] lexicographic max over conflicting actives (INT32_MIN lanes
-       where no conflict).
+    -> (i32[B, 3] lexicographic max (INT32_MIN lanes where no conflict),
+        i32[B] winning row (-1 where none)).
     """
     overlap = jax.lax.dot_general(
         subj_bitmaps.astype(jnp.bfloat16), act_bitmaps.astype(jnp.bfloat16),
         (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) > 0.5
-    conflicts = witness_table[subj_kinds[:, None], act_kinds[None, :]] == 1
-    conflicts |= witness_table[act_kinds[None, :], subj_kinds[:, None]] == 1
-    mask = overlap & conflicts & act_valid[None, :]
+    mask = overlap & act_valid[None, :]
     neg = jnp.int32(np.iinfo(np.int32).min)
     # lexicographic max without int64: successive tie-narrowing per lane
     l0 = jnp.where(mask, act_exec_ts[None, :, 0], neg)
@@ -85,7 +84,11 @@ def max_conflict(subj_bitmaps, subj_kinds, act_bitmaps, act_exec_ts,
     tie1 = tie0 & (act_exec_ts[None, :, 1] == m1[:, None])
     l2 = jnp.where(tie1, act_exec_ts[None, :, 2], neg)
     m2 = jnp.max(l2, axis=1)
-    return jnp.stack([m0, m1, m2], axis=1)
+    tie2 = tie1 & (act_exec_ts[None, :, 2] == m2[:, None])
+    # winning row per subject (first among ties); -1 when no conflict
+    row = jnp.where(jnp.any(tie2, axis=1),
+                    jnp.argmax(tie2, axis=1).astype(jnp.int32), -1)
+    return jnp.stack([m0, m1, m2], axis=1), row
 
 
 @functools.partial(jax.jit, static_argnames=("iterations",))
@@ -118,6 +121,14 @@ def execution_wavefronts(adj, max_levels: int):
         return jnp.maximum(level, jnp.max(dep_levels, axis=1))
 
     return jax.lax.fori_loop(0, max_levels, body, jnp.zeros(n, jnp.int32))
+
+
+@jax.jit
+def scatter_rows(dst, idx, rows):
+    """dst[cap, ...] with dst[idx[i]] = rows[i] -- the incremental device
+    active-set update (dirty rows only; jit caches per (cap, len(idx)) shape
+    bucket)."""
+    return dst.at[idx].set(rows)
 
 
 def pad_to(x: np.ndarray, size: int, axis: int = 0) -> np.ndarray:

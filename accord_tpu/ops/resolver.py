@@ -7,17 +7,22 @@ query is an SPI:
 
   HostDepsResolver  -- delegates to the store's Python scan (reference
                        behaviour, used for differential testing)
-  BatchDepsResolver -- encodes the store's active set + a micro-batch of
-                       subjects as tensors and runs ops.kernels.deps_matrix
-                       on the device; exact per-key CSR is recovered on host
-                       by intersecting real key sets (bucket collisions are
-                       filtered, so the result equals the host scan).
+  BatchDepsResolver -- maintains an INCREMENTAL device mirror of each store's
+                       active set (append-only rows + status-lane updates fed
+                       by the store's register() funnel) and answers deps /
+                       max-conflict queries with batched MXU kernels; exact
+                       per-key CSR is recovered on host by intersecting real
+                       key sets (bucket collisions are filtered, so the result
+                       equals the host scan).
 
-Batching model: the protocol's map-reduce hands us one subject at a time;
-the resolver accumulates the store's active set lazily and (re)encodes only
-when it changed (epoch counter), so a burst of PreAccepts against the same
-store state is one encode + N cheap device rows, and a true micro-batch API
-(resolve_batch) serves the bench/pipelined path.
+Device-state maintenance (the SURVEY section-7 latency engineering):
+  - every store.register() appends a row or updates a row's lanes host-side
+    and marks it dirty; nothing is re-encoded wholesale (the round-1 design
+    re-encoded the full active set per PreAccept: O(n^2) cumulative);
+  - rows are pushed to the device lazily, right before a kernel call, as a
+    single scatter of the dirty rows (padded to power-of-two buckets so jit
+    caches stay warm);
+  - capacity doubles by re-pushing whole arrays (rare, amortized).
 """
 from __future__ import annotations
 
@@ -32,6 +37,7 @@ from accord_tpu.ops.encoding import (
 from accord_tpu.primitives.deps import Deps, KeyDepsBuilder
 from accord_tpu.primitives.keyspace import Keys, Seekables
 from accord_tpu.primitives.timestamp import Timestamp, TxnId
+from accord_tpu.utils.invariants import Invariants
 
 
 class DepsResolver:
@@ -39,32 +45,157 @@ class DepsResolver:
                     before: Timestamp) -> Deps:
         raise NotImplementedError
 
+    def on_register(self, store, txn_id: TxnId, keys, status: CfkStatus,
+                    witnessed_at: Timestamp) -> None:
+        """Observer hook: the store reports every conflict-registry update."""
+
+    def max_conflict(self, store, txn_id: TxnId,
+                     seekables: Seekables) -> Tuple[bool, Optional[Timestamp]]:
+        """Optional device path for the max-conflict query; (False, _) means
+        unsupported here -- ask the host scan."""
+        return False, None
+
 
 class HostDepsResolver(DepsResolver):
     def resolve_one(self, store, txn_id, seekables, before) -> Deps:
         return store.host_calculate_deps(txn_id, seekables, before)
 
 
-class _ActiveSet:
-    """Snapshot of a store's witnessed key-txns in tensor form."""
+class _StoreDeviceState:
+    """Incremental device mirror of one store's key-domain active set.
 
-    def __init__(self, txn_ids: List[TxnId], key_sets: List[tuple],
-                 encoder: TimestampEncoder, num_buckets: int):
+    Host-side numpy arrays of capacity `cap` plus a device copy that is
+    synchronized by scattering dirty rows (or re-pushed wholesale after a
+    capacity growth). Rows are append-only; status changes touch lanes:
+      valid    -- False once INVALIDATED (drops the row from deps scans)
+      exec_ts  -- monotone max of registered conflict timestamps (feeds the
+                  max-conflict kernel)
+    """
+
+    GROW = 2
+
+    def __init__(self, num_buckets: int, initial_cap: int = 256):
+        self.num_buckets = num_buckets
+        self.cap = initial_cap
+        self.count = 0
+        self.txn_ids: List[TxnId] = []
+        self.key_sets: List[tuple] = []
+        self.row_of: Dict[TxnId, int] = {}
+        self.encoder: Optional[TimestampEncoder] = None
+        self.bitmaps = np.zeros((self.cap, num_buckets), dtype=np.float32)
+        self.ts = np.zeros((self.cap, 3), dtype=np.int32)
+        self.exec_ts = np.full((self.cap, 3), np.iinfo(np.int32).min,
+                               dtype=np.int32)
+        self.kinds = np.zeros(self.cap, dtype=np.int32)
+        self.valid = np.zeros(self.cap, dtype=bool)
+        self.exec_max: List[Optional[Timestamp]] = []
+        self._dirty_rows: set = set()
+        self._device = None          # tuple of jnp arrays or None
+        self._device_count = 0       # rows valid on device
+
+    # -- host-side mutation ---------------------------------------------------
+    def _ensure_encoder(self, ts: Timestamp) -> None:
+        if self.encoder is None:
+            # base epoch 0: epochs are small ints, and the epoch delta must
+            # stay non-negative even when an OLDER-epoch txn registers after
+            # a newer one (ExtraEpochs re-contacts send old-epoch txn ids to
+            # new-epoch replicas); the hlc window is symmetric around the
+            # first-seen hlc
+            self.encoder = TimestampEncoder(0, ts.hlc)
+
+    def _grow(self) -> None:
+        new_cap = self.cap * self.GROW
+        for name in ("bitmaps", "ts", "exec_ts", "kinds", "valid"):
+            a = getattr(self, name)
+            pad = [(0, new_cap - self.cap)] + [(0, 0)] * (a.ndim - 1)
+            setattr(self, name, np.pad(
+                a, pad, constant_values=(np.iinfo(np.int32).min
+                                         if name == "exec_ts" else 0)))
+        self.cap = new_cap
+        self._device = None  # full re-push
+
+    def append(self, txn_id: TxnId, key_set: tuple,
+               conflict_ts: Timestamp) -> int:
+        self._ensure_encoder(txn_id)
+        Invariants.check_state(self.encoder.in_window(txn_id),
+                               "active txn %s outside encoder window", txn_id)
+        if self.count == self.cap:
+            self._grow()
+        row = self.count
+        self.count += 1
+        self.txn_ids.append(txn_id)
+        self.key_sets.append(key_set)
+        self.exec_max.append(None)
+        self.row_of[txn_id] = row
+        bm = self.bitmaps[row]
+        for k in key_set:
+            bm[int(k) % self.num_buckets] = 1.0
+        self.ts[row] = self.encoder.encode([txn_id])[0]
+        self.kinds[row] = int(txn_id.kind)
+        self.valid[row] = True
+        self._bump_exec(row, conflict_ts)
+        self._dirty_rows.add(row)
+        return row
+
+    def _bump_exec(self, row: int, conflict_ts: Timestamp) -> None:
+        prev = self.exec_max[row]
+        if prev is None or conflict_ts > prev:
+            self.exec_max[row] = conflict_ts
+            self.exec_ts[row] = self.encoder.encode([conflict_ts])[0]
+
+    def update(self, txn_id: TxnId, key_set: tuple, status: CfkStatus,
+               conflict_ts: Timestamp) -> None:
+        row = self.row_of.get(txn_id)
+        if row is None:
+            row = self.append(txn_id, key_set, conflict_ts)
+        else:
+            # a later registration may widen the key set (partial txn
+            # unions) -- including invalidations, whose keys must stay
+            # visible to the (monotone) max-conflict kernel
+            if key_set and any(k not in self.key_sets[row] for k in key_set):
+                merged = tuple(sorted(set(self.key_sets[row]) | set(key_set)))
+                self.key_sets[row] = merged
+                bm = self.bitmaps[row]
+                for k in merged:
+                    bm[int(k) % self.num_buckets] = 1.0
+            # MaxConflicts is monotone in the reference: even an invalidated
+            # txn's registration bumps the conflict floor
+            self._bump_exec(row, conflict_ts)
+        if status == CfkStatus.INVALIDATED:
+            # drops the row from deps scans (a dep that never applies);
+            # never reset -- invalidation is terminal
+            self.valid[row] = False
+        self._dirty_rows.add(row)
+
+    # -- device sync ----------------------------------------------------------
+    def device_arrays(self):
+        """Sync the device mirror and return (bitmaps, ts, exec_ts, kinds,
+        valid) as jnp arrays of shape [cap, ...]."""
         import jax.numpy as jnp
-        self.txn_ids = txn_ids
-        self.key_sets = key_sets
-        self.encoder = encoder
-        n = max(1, len(txn_ids))
         from accord_tpu.ops.kernels import bucket_size, pad_to
-        padded = bucket_size(n)
-        bitmaps = encode_key_bitmaps(key_sets, num_buckets)
-        ts = encoder.encode(txn_ids) if txn_ids else np.zeros((0, 3), np.int32)
-        kinds = np.array([int(t.kind) for t in txn_ids], dtype=np.int32)
-        valid = np.ones(len(txn_ids), dtype=bool)
-        self.bitmaps = jnp.asarray(pad_to(bitmaps, padded))
-        self.ts = jnp.asarray(pad_to(ts, padded))
-        self.kinds = jnp.asarray(pad_to(kinds, padded))
-        self.valid = jnp.asarray(pad_to(valid, padded))
+        if self._device is None:
+            self._device = tuple(jnp.asarray(a) for a in (
+                self.bitmaps, self.ts, self.exec_ts, self.kinds, self.valid))
+            self._dirty_rows.clear()
+            self._device_count = self.count
+            return self._device
+        if self._dirty_rows:
+            from accord_tpu.ops.kernels import scatter_rows
+            rows = sorted(self._dirty_rows)
+            m = bucket_size(len(rows))
+            # pad by repeating the first dirty row: duplicate scatter indexes
+            # then write identical (correct) data, so padding is harmless
+            idx = np.full(m, rows[0], dtype=np.int32)
+            idx[:len(rows)] = rows
+            jidx = jnp.asarray(idx)
+            self._device = tuple(
+                scatter_rows(dev, jidx, jnp.asarray(host[idx]))
+                for dev, host in zip(self._device,
+                                     (self.bitmaps, self.ts, self.exec_ts,
+                                      self.kinds, self.valid)))
+            self._dirty_rows.clear()
+            self._device_count = self.count
+        return self._device
 
 
 class BatchDepsResolver(DepsResolver):
@@ -72,37 +203,30 @@ class BatchDepsResolver(DepsResolver):
         import jax.numpy as jnp
         self.num_buckets = num_buckets
         self._table = jnp.asarray(WITNESS_TABLE)
-        self._cache: Dict[int, Tuple[int, _ActiveSet]] = {}  # store id -> (version, set)
-        self._versions: Dict[int, int] = {}
+        self._states: Dict[int, _StoreDeviceState] = {}
 
-    # -- active-set maintenance ---------------------------------------------
-    def _store_version(self, store) -> int:
-        # cheap change detector: count of registered infos across cfks
-        return sum(len(c) for c in store.cfks.values()) + len(store.range_txns) * 1000003
+    def _state(self, store) -> _StoreDeviceState:
+        st = self._states.get(id(store))
+        if st is None:
+            st = _StoreDeviceState(self.num_buckets)
+            # adopt anything registered before the resolver was attached
+            # (update() routes INVALIDATED adoptions through append + the
+            # valid=False lane, matching the host scan's exclusion)
+            for key, cfk in store.cfks.items():
+                for t, info in cfk._infos.items():
+                    st.update(t, (key,),
+                              info.status,
+                              info.execute_at or t.as_timestamp())
+            self._states[id(store)] = st
+        return st
 
-    def _active_set(self, store) -> _ActiveSet:
-        version = self._store_version(store)
-        cached = self._cache.get(id(store))
-        if cached is not None and cached[0] == version:
-            return cached[1]
-        by_txn: Dict[TxnId, set] = {}
-        tss: List[Timestamp] = []
-        for key, cfk in store.cfks.items():
-            for t, info in cfk._infos.items():
-                if info.status == CfkStatus.INVALIDATED:
-                    continue
-                by_txn.setdefault(t, set()).add(key)
-        txn_ids = sorted(by_txn)
-        encoder = TimestampEncoder.for_timestamps(txn_ids or [Timestamp.NONE])
-        in_window = [t for t in txn_ids if encoder.in_window(t)]
-        # stragglers outside the window would need host supplement; with
-        # window ~35min of hlc this is unreachable in practice (invariant
-        # checked so it cannot silently drop deps)
-        assert len(in_window) == len(txn_ids), "active txn outside encoder window"
-        aset = _ActiveSet(txn_ids, [tuple(sorted(by_txn[t])) for t in txn_ids],
-                          encoder, self.num_buckets)
-        self._cache[id(store)] = (version, aset)
-        return aset
+    # -- observer hook (store.register funnel) --------------------------------
+    def on_register(self, store, txn_id: TxnId, keys, status: CfkStatus,
+                    witnessed_at: Timestamp) -> None:
+        if not isinstance(keys, Keys):
+            return  # range-domain txns stay host-side
+        st = self._state(store)
+        st.update(txn_id, tuple(sorted(keys)), status, witnessed_at)
 
     # -- SPI ----------------------------------------------------------------
     def resolve_one(self, store, txn_id, seekables, before) -> Deps:
@@ -113,9 +237,9 @@ class BatchDepsResolver(DepsResolver):
         rows = self.resolve_batch(store, [(txn_id, owned, before)])
         deps = rows[0]
         if store.range_txns:
-            # range txns are tracked host-side; union them in
-            host_range = store.host_calculate_deps(txn_id, owned, before)
-            deps = deps.union(host_range)
+            # range txns are tracked host-side; union ONLY those in (the
+            # device result already has the key-domain deps exactly)
+            deps = deps.union(store.host_range_deps(txn_id, owned, before))
         return deps
 
     def resolve_batch(self, store,
@@ -123,32 +247,76 @@ class BatchDepsResolver(DepsResolver):
         """Resolve deps for a micro-batch of (txn_id, owned keys, before)."""
         import jax.numpy as jnp
         from accord_tpu.ops.kernels import bucket_size, deps_matrix, pad_to
-        aset = self._active_set(store)
-        if not aset.txn_ids:
+        st = self._state(store)
+        if st.count == 0:
             return [Deps.NONE for _ in subjects]
         b = len(subjects)
         padded_b = bucket_size(b)
         bitmaps = encode_key_bitmaps([tuple(kk) for _, kk, _ in subjects],
                                      self.num_buckets)
-        before_ts = aset.encoder.encode([bound for _, _, bound in subjects])
+        before_ts = st.encoder.encode([bound for _, _, bound in subjects])
         kinds = np.array([int(t.kind) for t, _, _ in subjects], dtype=np.int32)
+        act_bm, act_ts, _, act_kinds, act_valid = st.device_arrays()
         matrix = deps_matrix(
             jnp.asarray(pad_to(bitmaps, padded_b)),
             jnp.asarray(pad_to(before_ts, padded_b)),
             jnp.asarray(pad_to(kinds, padded_b)),
-            aset.bitmaps, aset.ts, aset.kinds, aset.valid, self._table)
-        matrix = np.asarray(matrix)[:b, :len(aset.txn_ids)]
+            act_bm, act_ts, act_kinds, act_valid, self._table)
+        matrix = np.asarray(matrix)[:b, :st.count]
         out: List[Deps] = []
         for i, (subj_id, subj_keys, _) in enumerate(subjects):
             kb = KeyDepsBuilder()
             subj_set = set(subj_keys)
             for j in np.nonzero(matrix[i])[0]:
-                dep_id = aset.txn_ids[j]
+                dep_id = st.txn_ids[j]
                 if dep_id == subj_id:
                     continue  # device compares by (ts) bound; exclude self
                 # exact per-key recovery: bucket collisions filtered here
-                for k in aset.key_sets[j]:
+                for k in st.key_sets[j]:
                     if k in subj_set:
                         kb.add(k, dep_id)
             out.append(Deps(kb.build()))
+        return out
+
+    # -- max-conflict (device path for preaccept_timestamp) ------------------
+    def max_conflict(self, store, txn_id: TxnId,
+                     seekables: Seekables) -> Tuple[bool, Optional[Timestamp]]:
+        if not isinstance(seekables, Keys):
+            return False, None
+        res = self.max_conflict_batch(store, [(txn_id, seekables)])
+        return res[0]
+
+    def max_conflict_batch(self, store, subjects) -> List[Tuple[bool, Optional[Timestamp]]]:
+        """subjects: [(txn_id, keys)] -> (handled, max conflicting registered
+        timestamp) per subject. The device returns the winning row; a bucket-
+        collision false positive (row's real keys don't intersect) falls back
+        to the host scan for that subject (rare)."""
+        import jax.numpy as jnp
+        from accord_tpu.ops.kernels import bucket_size, max_conflict, pad_to
+        st = self._state(store)
+        if st.count == 0:
+            return [(True, None) for _ in subjects]
+        b = len(subjects)
+        padded_b = bucket_size(b)
+        bitmaps = encode_key_bitmaps([tuple(kk) for _, kk in subjects],
+                                     self.num_buckets)
+        act_bm, _, act_exec, _, act_valid = st.device_arrays()
+        # registered rows count even when invalidated (MaxConflicts is
+        # monotone in the reference); valid lane is NOT applied here
+        all_rows = jnp.ones_like(act_valid)
+        _, rows = max_conflict(
+            jnp.asarray(pad_to(bitmaps, padded_b)),
+            act_bm, act_exec, all_rows)
+        rows = np.asarray(rows)[:b]
+        out: List[Tuple[bool, Optional[Timestamp]]] = []
+        for i, (subj_id, subj_keys) in enumerate(subjects):
+            j = int(rows[i])
+            if j < 0 or j >= st.count:
+                out.append((True, None))
+                continue
+            subj_set = set(subj_keys)
+            if any(k in subj_set for k in st.key_sets[j]):
+                out.append((True, st.exec_max[j]))
+            else:
+                out.append((False, None))  # bucket collision: host decides
         return out

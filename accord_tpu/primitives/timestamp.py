@@ -103,7 +103,7 @@ _WITNESSES = {
 class Timestamp:
     """(epoch, hlc, flags, node) with total order. Immutable."""
 
-    __slots__ = ("epoch", "hlc", "flags", "node")
+    __slots__ = ("epoch", "hlc", "flags", "node", "_cmp")
 
     def __init__(self, epoch: int, hlc: int, flags: int, node: NodeId):
         assert 0 <= epoch < (1 << _EPOCH_BITS)
@@ -114,6 +114,11 @@ class Timestamp:
         object.__setattr__(self, "hlc", hlc)
         object.__setattr__(self, "flags", flags)
         object.__setattr__(self, "node", node)
+        # one order-preserving int for the (epoch, hlc, flags, node) total
+        # order: comparisons and hashing are the simulator's hottest ops
+        object.__setattr__(self, "_cmp",
+                           (((epoch << _HLC_BITS) | hlc) << (_FLAGS_BITS + _NODE_BITS))
+                           | (flags << _NODE_BITS) | node)
 
     def __setattr__(self, *a):
         raise AttributeError("immutable")
@@ -129,22 +134,22 @@ class Timestamp:
         return (self.epoch, self.hlc, self.flags, self.node)
 
     def __lt__(self, other: "Timestamp") -> bool:
-        return self._key() < other._key()
+        return self._cmp < other._cmp
 
     def __le__(self, other: "Timestamp") -> bool:
-        return self._key() <= other._key()
+        return self._cmp <= other._cmp
 
     def __gt__(self, other: "Timestamp") -> bool:
-        return self._key() > other._key()
+        return self._cmp > other._cmp
 
     def __ge__(self, other: "Timestamp") -> bool:
-        return self._key() >= other._key()
+        return self._cmp >= other._cmp
 
     def __eq__(self, other) -> bool:
-        return isinstance(other, Timestamp) and self._key() == other._key()
+        return isinstance(other, Timestamp) and self._cmp == other._cmp
 
     def __hash__(self) -> int:
-        return hash(self._key())
+        return hash(self._cmp)
 
     # -- rejection flag (reference: Timestamp.REJECTED_FLAG / asRejected) ----
     @property

@@ -46,6 +46,7 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
              key_count: int = 32, concurrency: int = 8,
              write_ratio: float = 0.7, max_keys_per_txn: int = 3,
              chaos_drop: float = 0.0, chaos_partitions: bool = False,
+             topology_churn: bool = False, churn_interval_ms: float = 1000.0,
              config: Optional[ClusterConfig] = None,
              collect_log: bool = False) -> BurnReport:
     cfg = config or ClusterConfig(num_nodes=nodes, rf=rf)
@@ -136,14 +137,32 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
     if chaos_drop > 0.0 or chaos_partitions:
         cluster.queue.add(500_000, chaos_tick)
 
+    # topology churn: split/merge/move shards every simulated second (the
+    # reference's TopologyRandomizer, test topology/TopologyRandomizer.java:60);
+    # stops once the workload completes so stragglers can recover to quiescence.
+    if topology_churn:
+        from accord_tpu.sim.topology_randomizer import TopologyRandomizer
+        TopologyRandomizer(cluster, cluster.rng.fork(),
+                           interval_us=int(churn_interval_ms * 1000),
+                           should_stop=lambda: state["completed"] >= ops).start()
+
     # kick off with bounded concurrency
     for i in range(min(concurrency, ops)):
         cluster.queue.add(wl_rng.next_int(20_000), submit)
 
-    report.events = cluster.drain(max_events=ops * 4000)
+    report.events = cluster.drain(max_events=ops * 20000)
     report.elapsed_sim_ms = (cluster.queue.now_micros - 1_000_000) / 1000.0
     report.lost = state["submitted"] - state["completed"]
 
+    if not cluster.queue.is_empty():
+        # the final-state checks below are only meaningful at quiescence;
+        # hitting the event cap usually means a liveness bug (or a straggler
+        # recovery tail larger than the cap) -- report it as such rather than
+        # as a bogus divergence
+        raise AssertionError(
+            f"no quiescence after {report.events} events "
+            f"({len(cluster.queue)} pending, sim {report.elapsed_sim_ms:.0f}ms, "
+            f"completed {state['completed']}/{state['submitted']})")
     cluster.check_no_failures()
     verifier.check_final_state(cluster.converged_key_lists())
     return report
@@ -162,6 +181,9 @@ def main(argv=None) -> int:
                     help="max per-link drop probability (re-randomized every 2s)")
     ap.add_argument("--chaos-partitions", action="store_true",
                     help="periodically partition a random node")
+    ap.add_argument("--topology-churn", action="store_true",
+                    help="randomly split/merge/move shards during the burn")
+    ap.add_argument("--churn-interval-ms", type=float, default=1000.0)
     ap.add_argument("--reconcile", action="store_true",
                     help="run each seed twice; require identical logs")
     args = ap.parse_args(argv)
@@ -171,7 +193,9 @@ def main(argv=None) -> int:
         kwargs = dict(ops=args.ops, nodes=args.nodes, rf=args.rf,
                       key_count=args.keys, concurrency=args.concurrency,
                       chaos_drop=args.chaos_drop,
-                      chaos_partitions=args.chaos_partitions)
+                      chaos_partitions=args.chaos_partitions,
+                      topology_churn=args.topology_churn,
+                      churn_interval_ms=args.churn_interval_ms)
         try:
             r = run_burn(seed, collect_log=args.reconcile, **kwargs)
             if args.reconcile:

@@ -26,6 +26,7 @@ class ClusterConfig:
     def __init__(self, num_nodes: int = 3, rf: int = 3, num_shards: int = 4,
                  key_domain: int = 1 << 16, stores_per_node: int = 2,
                  timeout_ms: float = 1000.0, deps_resolver_factory=None,
+                 deps_batch_window_ms=0.0,
                  progress: bool = True, progress_interval_ms: float = 250.0,
                  progress_stall_ms: float = 1500.0, serialize: bool = True):
         self.num_nodes = num_nodes
@@ -36,6 +37,7 @@ class ClusterConfig:
         self.timeout_ms = timeout_ms
         # factory() -> DepsResolver; None = host scan (the reference path)
         self.deps_resolver_factory = deps_resolver_factory
+        self.deps_batch_window_ms = deps_batch_window_ms  # None = inline
         self.progress = progress  # enable the liveness/recovery engine
         self.progress_interval_ms = progress_interval_ms
         self.progress_stall_ms = progress_stall_ms
@@ -74,6 +76,9 @@ class SimTopologyService:
     def delivered_topology(self, node_id: NodeId) -> Topology:
         """The newest epoch this node has been handed (its 'current')."""
         return self.epochs[self._delivered.get(node_id, 1)]
+
+    def delivered_epoch(self, node_id: NodeId) -> int:
+        return self._delivered.get(node_id, 1)
 
     def mark_initial(self, node_id: NodeId) -> None:
         self._delivered[node_id] = 1
@@ -179,6 +184,7 @@ class Cluster:
                 progress_log_factory=progress_factory,
                 deps_resolver=(self.config.deps_resolver_factory()
                                if self.config.deps_resolver_factory else None),
+                deps_batch_window_ms=self.config.deps_batch_window_ms,
             )
             if engine is not None:
                 engine.bind(node)

@@ -44,6 +44,11 @@ class ListStore(api.DataStore):
 
     def append(self, key, at: Timestamp, value: int) -> None:
         entries = self.data.setdefault(key, [])
+        for ts, v in entries:
+            if v == value and ts != at:
+                raise AssertionError(
+                    f"value {value} applied twice to key {key} at different "
+                    f"executeAts: {ts} vs {at}")
         insort(entries, (at, value))
 
     def snapshot(self, key) -> Tuple[int, ...]:

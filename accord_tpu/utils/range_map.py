@@ -73,6 +73,20 @@ class ReducingRangeMap(Generic[V]):
             i += 1
         return True
 
+    def segments_where(self, start, end, pred: Callable[[V], bool]):
+        """Yield (seg_start, seg_end) clipped to [start, end) for every
+        segment whose non-None value satisfies pred."""
+        if not self.bounds or start >= end:
+            return
+        i = max(0, bisect_right(self.bounds, start) - 1)
+        while i < len(self.values):
+            seg_start, seg_end, v = self.bounds[i], self.bounds[i + 1], self.values[i]
+            if seg_start >= end:
+                break
+            if seg_end > start and v is not None and pred(v):
+                yield max(seg_start, start), min(seg_end, end)
+            i += 1
+
     def fold_values(self, fn: Callable[[Any, V], Any], acc):
         for v in self.values:
             if v is not None:

@@ -119,7 +119,9 @@ def test_batch_resolver_differential_vs_host():
 
 
 def test_burn_with_device_resolver_matches_host():
-    """End-to-end differential: identical event logs with either resolver."""
+    """End-to-end differential in INLINE mode (batch window None): the device
+    path answers every query synchronously with exactly the host scan's
+    results, so the two event logs must be bit-identical."""
     from accord_tpu.ops.resolver import BatchDepsResolver
     from accord_tpu.sim.burn import run_burn
     from accord_tpu.sim.cluster import ClusterConfig
@@ -127,6 +129,51 @@ def test_burn_with_device_resolver_matches_host():
     host = run_burn(seed=11, ops=40, collect_log=True)
     dev = run_burn(seed=11, ops=40, collect_log=True,
                    config=ClusterConfig(
-                       deps_resolver_factory=lambda: BatchDepsResolver(num_buckets=128)))
+                       deps_resolver_factory=lambda: BatchDepsResolver(num_buckets=128),
+                       deps_batch_window_ms=None))
     assert host.acked == dev.acked == 40
     assert host.log == dev.log
+
+
+def test_burn_with_batched_device_resolver():
+    """End-to-end with the micro-batch tick ON: replies defer to the per-store
+    tick, so timing (and thus logs) may differ from host -- but every op still
+    acks and strict serializability + convergence hold (checked inside
+    run_burn), and the run is deterministic."""
+    from accord_tpu.ops.resolver import BatchDepsResolver
+    from accord_tpu.sim.burn import run_burn
+    from accord_tpu.sim.cluster import ClusterConfig
+
+    def cfg():
+        return ClusterConfig(
+            deps_resolver_factory=lambda: BatchDepsResolver(num_buckets=128),
+            deps_batch_window_ms=0.0)
+
+    a = run_burn(seed=11, ops=40, collect_log=True, config=cfg())
+    assert a.acked == 40 and a.lost == 0
+    b = run_burn(seed=11, ops=40, collect_log=True, config=cfg())
+    assert a.log == b.log  # deterministic under batching
+
+
+def test_max_conflict_batch_vs_host():
+    """Device max-conflict must agree with the host MaxConflicts scan."""
+    from accord_tpu.ops.resolver import BatchDepsResolver
+    from accord_tpu.primitives.keyspace import Keys
+    from tests.test_local_engine import setup_store
+    rng = np.random.default_rng(13)
+    _, node, store = setup_store()
+    keys_list = [sorted(set(rng.integers(0, 40, rng.integers(1, 4)).tolist()))
+                 for _ in range(50)]
+    ids = _preaccept_population(store, node, keys_list)
+    resolver = BatchDepsResolver(num_buckets=128)
+    subjects = []
+    for i in rng.choice(len(ids), 15, replace=False):
+        subjects.append((ids[i], Keys(keys_list[i])))
+    got = resolver.max_conflict_batch(store, subjects)
+    for (subj, keys), (handled, ts) in zip(subjects, got):
+        host = store.max_conflict_ts(keys)
+        if handled:
+            assert ts == host, f"{subj}: device {ts} != host {host}"
+        else:
+            # bucket-collision fallback: the host path is consulted instead
+            assert host is not None
