@@ -102,14 +102,17 @@ class Recover(Callback):
     # -- the decision (reference: Recover.recover, coordinate/Recover.java:246)
     def _recover(self) -> None:
         self._decided = True
-        oks = list(self.oks.values())
+        # prefer informative replies; conclude TRUNCATED only when NO reply
+        # anywhere has surviving knowledge (reference: Recover.java:252-254,
+        # maxAcceptedNotTruncated): truncation implies the outcome was
+        # majority-durable, so a MaybeRecover/CheckStatus pass will repair
+        # local state from whatever replicas still carry it.
+        oks = [ok for ok in self.oks.values() if ok.status != Status.TRUNCATED]
+        if not oks:
+            self.result.try_set_success(Outcome.TRUNCATED)
+            return
         best = max(oks, key=lambda ok: recovery_rank(ok.status, ok.accepted_ballot))
         status = best.status
-        # NOTE: a truncated store currently surfaces as RecoverNack (never as
-        # a RecoverOk in self.oks); truncation implies the outcome was durable
-        # on a majority, so once durability rounds land the truncated case is
-        # resolved via CheckStatus/Outcome propagation rather than by re-running
-        # the accept-phase reasoning over stale surviving knowledge.
 
         if status == Status.INVALIDATED:
             self._commit_invalidate()
@@ -589,6 +592,23 @@ class MaybeRecover(Callback):
             self._acted = True
             self._propagate_invalidate(merged)
             return
+        if have_quorum and merged.status == Status.TRUNCATED:
+            # someone truncated the record: the outcome was durable. Apply it
+            # if the MERGED knowledge still carries it (a node-local merge
+            # can collapse an outcome-carrying store with a truncated sibling
+            # to status TRUNCATED while keeping txn/writes/executeAt);
+            # otherwise mark local records truncated so dependents stop
+            # waiting (reference: Infer/Cleanup propagation of truncation)
+            self._acted = True
+            outcome_available = (
+                merged.partial_txn is not None
+                and merged.execute_at is not None
+                and (not self.txn_id.kind.is_write or merged.writes is not None))
+            if outcome_available:
+                self._propagate_outcome(merged)
+            else:
+                self._propagate_truncated(merged)
+            return
         if have_quorum and merged.status.has_been(Status.PRE_APPLIED) \
                 and not merged.status.is_terminal:
             self._acted = True
@@ -648,6 +668,30 @@ class MaybeRecover(Callback):
                 commands.commit_invalidate(store, self.txn_id)
         self.result.try_set_success(Outcome.INVALIDATED)
 
+    def _propagate_truncated(self, merged: CheckStatusOk) -> None:
+        """The outcome is durable cluster-wide but no reachable reply carries
+        it any more. Mark local records truncated (dependents drop the edge);
+        a local replica that never applied a truncated WRITE has a data gap --
+        its copy can only be repaired by a fresh bootstrap snapshot."""
+        from accord_tpu.local import commands as _commands
+        from accord_tpu.local.status import Status as _S
+        scope = merged.route.participants if merged.route is not None \
+            else self.participants
+        for store in self.node.command_stores.all():
+            if not store.owns(scope):
+                continue
+            cmd = store.command_if_present(self.txn_id)
+            if cmd is None or cmd.status.is_terminal \
+                    or cmd.has_been(_S.APPLIED):
+                continue
+            if self.txn_id.kind.is_write:
+                owned = store.owned(scope)
+                store.mark_gap(_to_ranges(owned))
+            cmd.status = _S.TRUNCATED
+            _commands.notify_listeners(store, cmd)
+            store.progress_log.clear(self.txn_id)
+        self.result.try_set_success(Outcome.TRUNCATED)
+
     def _propagate_outcome(self, merged: CheckStatusOk) -> None:
         """Apply a remotely-known outcome to our local stores. Writes in a
         reply are the sender's slice, so each store only accepts replies whose
@@ -659,28 +703,33 @@ class MaybeRecover(Callback):
         # marking the command APPLIED would silently lose writes
         scope = merged.route.participants if merged.route is not None \
             else self.participants
+        # each reply's txn/writes are the SENDER's slice, but merge() unions
+        # them: the MERGED knowledge may cover a store no single reply does
+        # (common after topology churn re-shapes ownership)
         for store in self.node.command_stores.all():
             if not store.owns(scope):
                 continue
-            # a reply's txn/writes are the SENDER's slice; only accept one
-            # whose coverage includes this store's slice of the participants
             need = _to_ranges(store.owned(scope))
-            for ok in sorted((o for o in self.oks
-                              if o.status.has_been(Status.PRE_APPLIED)
-                              and not o.status.is_terminal
-                              and o.partial_txn is not None),
-                             key=lambda o: o.status, reverse=True):
-                if not ok.partial_txn.covers(need):
+            if merged.partial_txn is None or not merged.partial_txn.covers(need):
+                continue
+            w = merged.writes
+            if self.txn_id.kind.is_write:
+                # writes union from FEWER replies than partial_txn (STABLE
+                # replies carry txn but no writes): applying a narrower
+                # writes slice while marking APPLIED would silently lose
+                # writes for the uncovered keys
+                if w is None:
                     continue
-                w = ok.writes
-                partial = ok.partial_txn.slice(store.ranges, include_query=False)
-                deps = (ok.stable_deps or Deps.NONE).slice(store.ranges)
-                commands.apply(store, self.txn_id, merged.route or ok.route,
-                               partial, ok.execute_at, deps,
-                               w.slice(store.ranges) if w is not None else None,
-                               ok.result)
-                applied_any = True
-                break
+                needed_keys = set(merged.partial_txn.keys.slice(need))
+                if not needed_keys <= set(w.keys):
+                    continue
+            partial = merged.partial_txn.slice(store.ranges, include_query=False)
+            deps = (merged.stable_deps or Deps.NONE).slice(store.ranges)
+            commands.apply(store, self.txn_id, merged.route,
+                           partial, merged.execute_at, deps,
+                           w.slice(store.ranges) if w is not None else None,
+                           merged.result)
+            applied_any = True
         if applied_any:
             self.result.try_set_success(Outcome.APPLIED)
         else:

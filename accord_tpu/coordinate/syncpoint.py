@@ -38,9 +38,13 @@ class CoordinateSyncPoint(CoordinateTransaction):
 
     # -- entry points (reference: CoordinateSyncPoint.exclusive/inclusive) ---
     @classmethod
-    def exclusive(cls, node, seekables: Seekables) -> AsyncResult:
+    def exclusive(cls, node, seekables: Seekables,
+                  blocking: bool = False) -> AsyncResult:
+        """blocking=True completes only once an APPLIED quorum exists per
+        shard -- the durability rounds' prerequisite (everything ordered
+        below the sync point is then applied at a quorum)."""
         return cls._coordinate(node, TxnKind.EXCLUSIVE_SYNC_POINT, seekables,
-                               blocking=False)
+                               blocking=blocking)
 
     @classmethod
     def inclusive(cls, node, seekables: Seekables,

@@ -371,15 +371,15 @@ class _ExecuteRound(Callback):
 
 
 class _ApplyRound(Callback):
-    """Background durability: broadcast Apply and retry per-node until every
-    replica acks (bounded attempts). The client already has its result; these
-    retries are what keep stragglers convergent when Apply messages drop
-    (until durability rounds land, this is the reference's
-    persist-then-informDurable role)."""
+    """Background persist: broadcast Apply (the client already has its
+    result). A couple of retries cover transient drops; beyond that the
+    straggler-repair machinery owns convergence -- every replica's progress
+    engine tracks stable-but-unapplied commands and fetches the outcome via
+    CheckStatus/propagate, and durability rounds advance the floors behind it
+    (reference: Persist fire-and-forget + SimpleProgressLog +
+    CoordinateDurabilityScheduling)."""
 
-    # the sim has no permanent node failures, so persist keeps retrying
-    # through long partitions; durability rounds will replace this crutch
-    MAX_ATTEMPTS = 64
+    MAX_ATTEMPTS = 3
 
     def __init__(self, parent: CoordinateTransaction, writes, result,
                  on_applied=None):

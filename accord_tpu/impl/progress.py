@@ -117,15 +117,20 @@ class ProgressEngine:
 
     def _locally_resolved(self, entry: _Tracked) -> bool:
         """Done when every local store owning the participants has the command
-        applied or terminal."""
+        applied or terminal (a truncated record -- dropped below the
+        durability floor -- counts as terminal)."""
         any_store = False
         for store in self.node.command_stores.all():
             if not store.owns(entry.participants):
                 continue
             any_store = True
             cmd = store.command_if_present(entry.txn_id)
-            if cmd is None or not (cmd.has_been(Status.APPLIED)
-                                   or cmd.status.is_terminal):
+            if cmd is None or cmd.status == Status.NOT_DEFINED:
+                if store.is_truncated(entry.txn_id, entry.participants):
+                    continue
+                if cmd is None:
+                    return False
+            if not (cmd.has_been(Status.APPLIED) or cmd.status.is_terminal):
                 return False
         return any_store
 

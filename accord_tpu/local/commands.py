@@ -41,6 +41,8 @@ def preaccept(store: CommandStore, txn_id: TxnId, txn: PartialTxn, route: Route,
     """Witness the txn: record definition, pick the witnessed timestamp
     (stored provisionally in execute_at), register the conflict.
     (reference: Commands.preacceptOrRecover, local/Commands.java:125)"""
+    if store.is_truncated(txn_id, txn.keys):
+        return AcceptOutcome.TRUNCATED
     cmd = store.command(txn_id)
     if cmd.status.is_terminal:
         return AcceptOutcome.REJECTED_BALLOT if cmd.is_(Status.INVALIDATED) \
@@ -85,6 +87,8 @@ def accept(store: CommandStore, txn_id: TxnId, ballot: Ballot, route: Route,
     """(reference: Commands.accept, local/Commands.java:202). `deps` is the
     coordinator's proposal, retained so recovery can reconstruct the latest
     accepted proposal (reference stores partialDeps on the Accepted command)."""
+    if store.is_truncated(txn_id, keys):
+        return AcceptOutcome.TRUNCATED
     cmd = store.command(txn_id)
     if cmd.status.is_terminal:
         return AcceptOutcome.REJECTED_BALLOT if cmd.is_(Status.INVALIDATED) \
@@ -123,6 +127,8 @@ def recover(store: CommandStore, txn_id: TxnId, txn: PartialTxn, route: Route,
     to invalidate txns their original coordinator did not complete
     (reference: permitFastPath = ballot.equals(Ballot.ZERO),
     local/Commands.java:163-169)."""
+    if store.is_truncated(txn_id, txn.keys):
+        return AcceptOutcome.TRUNCATED
     cmd = store.command(txn_id)
     if cmd.is_(Status.TRUNCATED):
         return AcceptOutcome.TRUNCATED
@@ -179,6 +185,8 @@ def commit(store: CommandStore, txn_id: TxnId, route: Route, txn: Optional[Parti
            execute_at: Timestamp, deps: Deps) -> CommitOutcome:
     """Commit(Stable): executeAt + deps are final; build the local wait graph
     and schedule execution (reference: Commands.commit, local/Commands.java:289)."""
+    if store.is_truncated(txn_id, route.participants):
+        return CommitOutcome.REDUNDANT  # below the truncation horizon
     cmd = store.command(txn_id)
     if cmd.has_been(Status.STABLE):
         if not cmd.status.is_terminal and cmd.execute_at != execute_at:
@@ -239,6 +247,8 @@ def apply(store: CommandStore, txn_id: TxnId, route: Route, txn: Optional[Partia
           execute_at: Timestamp, deps: Deps, writes: Optional[Writes], result) -> CommitOutcome:
     """Persist the outcome; execute (write to the data store) once local deps
     have applied (reference: Commands.apply, local/Commands.java:462)."""
+    if store.is_truncated(txn_id, route.participants):
+        return CommitOutcome.REDUNDANT  # below the truncation horizon
     cmd = store.command(txn_id)
     if cmd.has_been(Status.PRE_APPLIED):
         if not cmd.status.is_terminal and cmd.execute_at != execute_at:
@@ -288,6 +298,14 @@ def _init_waiting_on(store: CommandStore, cmd: Command) -> None:
         if store.dep_elided_by_floor(cmd, dep_id):
             # below a bootstrap floor: its effects arrived with the fetched
             # snapshot; it will never individually apply on this store
+            continue
+        trunc_floor = store.truncation_elision_floor(cmd)
+        if trunc_floor is not None and dep_id.as_timestamp() < trunc_floor:
+            # below the truncation horizon on EVERY shared key: it applied
+            # locally before the floor advanced (redundant_before gates
+            # truncation) or it can never commit -- no wait edge needed.
+            # (min-floor semantics: a dep sharing only unfloored keys keeps
+            # its edge)
             continue
         dep = store.command(dep_id)
         if dep.is_(Status.INVALIDATED):

@@ -64,6 +64,7 @@ class CommandStore:
         # PreAccepts queue here and drain through ONE batched max-conflict +
         # ONE batched deps kernel call per tick
         self._preaccept_queue: list = []
+        self._deps_queue: list = []
         self._tick_scheduled = False
         self._mc_override: Optional[Dict[TxnId, Optional[Timestamp]]] = None
         # 0.0 = coalesce same-scheduler-turn arrivals; None = inline (no
@@ -94,6 +95,16 @@ class CommandStore:
         self.data_gaps: Ranges = Ranges.EMPTY
         # bootstraps currently acquiring ranges for this store
         self.active_bootstraps: list = []
+        # durability floors (reference: local/DurableBefore.java:39):
+        #   durable_majority  -- ids below it are applied at a quorum of
+        #     every replica set (advanced by SetShardDurable rounds)
+        #   durable_universal -- applied at EVERY replica (SetGloballyDurable)
+        self.durable_majority: ReducingRangeMap = ReducingRangeMap.EMPTY
+        self.durable_universal: ReducingRangeMap = ReducingRangeMap.EMPTY
+        # ids below this floor had their local per-txn state truncated
+        # (reference: local/Cleanup.java + Commands.purge): probes answer
+        # TRUNCATED -- the outcome was durable, the record is gone
+        self.truncated_before: ReducingRangeMap = ReducingRangeMap.EMPTY
 
     # -- execution context ---------------------------------------------------
     def execute(self, fn: Callable[["CommandStore"], None]) -> AsyncResult:
@@ -287,6 +298,105 @@ class CommandStore:
             self.redundant_before = self.redundant_before.with_range(
                 r.start, r.end, ts, Timestamp.merge_max)
 
+    # -- durability + truncation (reference: DurableBefore.java:39,
+    # Cleanup.java, cfk/Pruning.java:41) -------------------------------------
+    def mark_shard_durable(self, sync_id: TxnId, ranges: Ranges) -> None:
+        """Everything below `sync_id` on `ranges` is applied at a quorum of
+        every replica set (a durability round's ExclusiveSyncPoint reached an
+        applied quorum). Advances the majority floor and truncates."""
+        ts = sync_id.as_timestamp()
+        for r in ranges.intersection(self.ranges):
+            self.durable_majority = self.durable_majority.with_range(
+                r.start, r.end, ts, Timestamp.merge_max)
+        self.cleanup()
+
+    def mark_globally_durable(self, segments) -> None:
+        """[(start, end, ts)]: ids below ts applied at EVERY replica."""
+        for start, end, ts in segments:
+            self.durable_universal = self.durable_universal.with_range(
+                start, end, ts, Timestamp.merge_max)
+
+    def is_truncated(self, txn_id: TxnId, seekables: Seekables) -> bool:
+        """Was this txn's local record truncated? (Any owned part below the
+        truncation floor: below it every txn either applied durably or was
+        invalidated, and the record is gone either way.)"""
+        if self.truncated_before.is_empty():
+            return False
+        ts = txn_id.as_timestamp()
+        owned = self.owned(seekables)
+        if isinstance(owned, Keys):
+            return any((f := self.truncated_before.get(k)) is not None and ts < f
+                       for k in owned)
+        hit = False
+        for r in _as_ranges(owned):
+            hit = self.truncated_before.fold_over_range(
+                r.start, r.end, lambda acc, f: acc or ts < f, hit)
+        return hit
+
+    def cleanup(self) -> None:
+        """Truncate per-txn state below min(durable_majority, redundant_before):
+        state both locally redundant (every conflicting txn below the floor
+        has applied here) AND majority-durable may be dropped; probes for it
+        answer TRUNCATED (reference: Cleanup deciding the erase level). The
+        floor is an ExclusiveSyncPoint id, and the LATEST sync point is never
+        below its own floor, so it survives to carry the transitive ordering
+        edge for laggards."""
+        from accord_tpu.utils.range_map import min_intersection
+        floor_map = min_intersection(self.durable_majority, self.redundant_before)
+        if floor_map.is_empty():
+            return
+        from accord_tpu.local.status import Status as _S
+        dropped = []
+        for txn_id, cmd in self.commands.items():
+            if not (cmd.has_been(_S.APPLIED) or cmd.is_(_S.INVALIDATED)):
+                continue
+            if cmd.waiters:
+                continue  # someone still watches it; let them resolve first
+            keys = cmd.txn.keys if cmd.txn is not None else None
+            ts = txn_id.as_timestamp()
+            if keys is None:
+                # blind invalidation (never witnessed here, no definition):
+                # droppable once the WHOLE owned slice is floored above it,
+                # else these records accumulate forever under chaos
+                if cmd.is_(_S.INVALIDATED) and all(
+                        floor_map.covers(r.start, r.end, lambda f: ts < f)
+                        for r in self.ranges):
+                    dropped.append(txn_id)
+                continue
+            owned = self.owned(keys)
+            if isinstance(owned, Keys):
+                if len(owned) == 0 or not all(
+                        (f := floor_map.get(k)) is not None and ts < f
+                        for k in owned):
+                    continue
+            else:
+                if owned.is_empty() or not all(
+                        floor_map.covers(r.start, r.end, lambda f: ts < f)
+                        for r in _as_ranges(owned)):
+                    continue
+            dropped.append(txn_id)
+        for txn_id in dropped:
+            cmd = self.commands.pop(txn_id)
+            if cmd.txn is not None:
+                owned = self.owned(cmd.txn.keys)
+                if isinstance(owned, Keys):
+                    for k in owned:
+                        c = self.cfks.get(k)
+                        if c is not None:
+                            c.remove(txn_id)
+                            if c.is_empty():
+                                del self.cfks[k]
+            self.range_txns.pop(txn_id, None)
+            if self.deps_resolver is not None:
+                self.deps_resolver.on_truncate(self, txn_id)
+            self.progress_log.clear(txn_id)
+        # advance the truncation horizon over the whole floored region: ids
+        # below it either applied durably, were invalidated, or can never
+        # commit (the sync point's reject floor covers new arrivals)
+        from accord_tpu.utils.range_map import merge as _merge
+        self.truncated_before = _merge(self.truncated_before, floor_map,
+                                       Timestamp.merge_max)
+
     # -- bootstrap floor (reference: local/Bootstrap.java:81 doc :28-80) -----
     def set_bootstrap_floor(self, sync_id: TxnId, ranges: Ranges) -> None:
         """The bootstrap's ExclusiveSyncPoint id becomes the floor for
@@ -357,19 +467,30 @@ class CommandStore:
         if cached is not None and cached[0] is self.bootstrapped_at \
                 and cached[1] is cmd.txn and cached[2] is self._owned_union:
             return cached[3]
-        floor = self._compute_elision_floor(cmd)
+        floor = self._min_floor_over(cmd, self.bootstrapped_at)
         cmd.elision_floor_cache = (self.bootstrapped_at, cmd.txn,
                                    self._owned_union, floor)
         return floor
 
-    def _compute_elision_floor(self, cmd) -> Optional[Timestamp]:
+    def truncation_elision_floor(self, cmd) -> Optional[Timestamp]:
+        """min truncation floor over the waiter's owned keys (None when any
+        owned key is unfloored). Deps strictly below it are safe to skip:
+        every shared key is below a durability sync point that witnessed and
+        waited out the dep, so its effects applied here before the floor
+        advanced. (ANY-key semantics would skip deps sharing only unfloored
+        keys -- a serializability hole.)"""
+        if self.truncated_before.is_empty() or cmd.txn is None:
+            return None
+        return self._min_floor_over(cmd, self.truncated_before)
+
+    def _min_floor_over(self, cmd, floor_map: ReducingRangeMap) -> Optional[Timestamp]:
         owned = self.owned(cmd.txn.keys)
         out: Optional[Timestamp] = None
         if isinstance(owned, Keys):
             if len(owned) == 0:
                 return None
             for k in owned:
-                f = self.bootstrapped_at.get(k)
+                f = floor_map.get(k)
                 if f is None:
                     return None
                 out = f if out is None or f < out else out
@@ -378,9 +499,9 @@ class CommandStore:
             return None
         # every point of every owned range must be floored; take the min
         for r in _as_ranges(owned):
-            if not self.bootstrapped_at.covers(r.start, r.end, lambda f: True):
+            if not floor_map.covers(r.start, r.end, lambda f: True):
                 return None
-            out = self.bootstrapped_at.fold_over_range(
+            out = floor_map.fold_over_range(
                 r.start, r.end,
                 lambda acc, f: f if acc is None or f < acc else acc, out)
         return out
@@ -412,6 +533,21 @@ class CommandStore:
             return self.deps_resolver.resolve_one(self, txn_id, seekables, before)
         return self.host_calculate_deps(txn_id, seekables, before)
 
+    def calculate_deps_async(self, txn_id: TxnId, seekables: Seekables,
+                             before: Timestamp) -> AsyncResult:
+        """calculate_deps, micro-batched through the per-store tick alongside
+        queued PreAccepts (the Accept round's deps query is as hot as
+        PreAccept's under contention -- the slow path runs both)."""
+        resolver = self.deps_resolver
+        if resolver is None or not hasattr(resolver, "resolve_batch") \
+                or not isinstance(seekables, Keys) \
+                or self.batch_window_ms is None:
+            return success(self.calculate_deps(txn_id, seekables, before))
+        out = AsyncResult()
+        self._deps_queue.append((txn_id, seekables, before, out))
+        self._schedule_tick()
+        return out
+
     # -- the micro-batched PreAccept path ------------------------------------
     def submit_preaccept(self, txn_id: TxnId, partial_txn, route,
                          ballot=None) -> AsyncResult:
@@ -431,10 +567,13 @@ class CommandStore:
             return success(self._preaccept_now(txn_id, partial_txn, route, ballot))
         out = AsyncResult()
         self._preaccept_queue.append((txn_id, partial_txn, route, ballot, out))
+        self._schedule_tick()
+        return out
+
+    def _schedule_tick(self) -> None:
         if not self._tick_scheduled:
             self._tick_scheduled = True
             self.node.scheduler.once(self.batch_window_ms, self._preaccept_tick)
-        return out
 
     def _preaccept_now(self, txn_id, partial_txn, route, ballot):
         from accord_tpu.local import commands
@@ -451,7 +590,10 @@ class CommandStore:
         from accord_tpu.local.commands import AcceptOutcome
         self._tick_scheduled = False
         batch, self._preaccept_queue = self._preaccept_queue, []
+        deps_batch, self._deps_queue = self._deps_queue, []
         if not batch:
+            if deps_batch:
+                self._drain_deps_queue(deps_batch)
             return
         # phase 1: one batched max-conflict for every queued subject
         # (handled=False = bucket collision: the host scan decides, recorded
@@ -482,10 +624,14 @@ class CommandStore:
                                    self.command(t).execute_at, out))
         finally:
             self._mc_override = None
-        # phase 3: one batched deps resolve for the accepted subjects
+        # phase 3: ONE batched deps resolve for the accepted subjects plus
+        # any queued standalone deps queries (Accept-round / GetDeps)
         subjects = [(t, self.owned(p.keys), w)
                     for (t, p, oc, w, _) in phase1 if w is not None]
-        rows = self.deps_resolver.resolve_batch(self, subjects) if subjects else []
+        extra = [(t, self.owned(ks), before)
+                 for (t, ks, before, _) in deps_batch]
+        rows = self.deps_resolver.resolve_batch(self, subjects + extra) \
+            if subjects or extra else []
         need_host_ranges = bool(self.range_txns)
         it = iter(rows)
         for (t, p, oc, w, out) in phase1:
@@ -499,6 +645,21 @@ class CommandStore:
                 deps = deps.union(self.host_range_deps(
                     t, self.owned(p.keys), w))
             out.try_set_success((oc, w, deps))
+        for (t, ks, before, out) in deps_batch:
+            deps = next(it)
+            if need_host_ranges:
+                deps = deps.union(self.host_range_deps(t, self.owned(ks), before))
+            out.try_set_success(deps)
+
+    def _drain_deps_queue(self, deps_batch) -> None:
+        subjects = [(t, self.owned(ks), before)
+                    for (t, ks, before, _) in deps_batch]
+        rows = self.deps_resolver.resolve_batch(self, subjects)
+        need_host_ranges = bool(self.range_txns)
+        for (t, ks, before, out), deps in zip(deps_batch, rows):
+            if need_host_ranges:
+                deps = deps.union(self.host_range_deps(t, self.owned(ks), before))
+            out.try_set_success(deps)
 
     def host_range_deps(self, txn_id: TxnId, seekables: Seekables,
                         before: Timestamp) -> Deps:

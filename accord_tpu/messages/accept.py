@@ -25,25 +25,38 @@ class Accept(Request):
         self.wait_for_epoch = max(txn_id.epoch, execute_at.epoch)
 
     def process(self, node, from_node, reply_context) -> None:
-        def map_fn(store):
+        from accord_tpu.utils.async_ import all_of, success
+
+        stores = node.command_stores.intersecting(self.keys)
+        if not stores:
+            node.reply(from_node, reply_context, None)
+            return
+
+        def one_store(store):
             outcome = commands.accept(store, self.txn_id, self.ballot, self.route,
                                       store.owned(self.keys), self.execute_at,
                                       self.deps)
             if outcome == AcceptOutcome.REJECTED_BALLOT:
-                return AcceptNack(self.txn_id, store.command(self.txn_id).promised)
+                return success(AcceptNack(self.txn_id,
+                                          store.command(self.txn_id).promised))
             if outcome == AcceptOutcome.TRUNCATED:
-                return AcceptNack(self.txn_id, None)
-            deps = store.calculate_deps(self.txn_id, store.owned(self.keys),
-                                        self.execute_at)
-            return AcceptOk(self.txn_id, deps)
+                return success(AcceptNack(self.txn_id, None))
+            # deps up to executeAt, micro-batched onto the device tick
+            return store.calculate_deps_async(
+                self.txn_id, store.owned(self.keys), self.execute_at) \
+                .map(lambda deps: AcceptOk(self.txn_id, deps))
 
-        def reduce_fn(a, b):
-            if isinstance(a, AcceptNack) or isinstance(b, AcceptNack):
-                return a if isinstance(a, AcceptNack) else b
-            return AcceptOk(self.txn_id, a.deps.union(b.deps))
+        def finish(parts):
+            reply = None
+            for part in parts:
+                if isinstance(part, AcceptNack):
+                    reply = part
+                    break
+                reply = part if reply is None \
+                    else AcceptOk(self.txn_id, reply.deps.union(part.deps))
+            node.reply(from_node, reply_context, reply)
 
-        node.command_stores.map_reduce(self.keys, map_fn, reduce_fn) \
-            .on_success(lambda reply: node.reply(from_node, reply_context, reply)) \
+        all_of([one_store(s) for s in stores]).on_success(finish) \
             .on_failure(node.agent.on_uncaught_exception)
 
     def __repr__(self):

@@ -1,0 +1,92 @@
+"""Durability floor propagation.
+
+Role-equivalent to the reference's SetShardDurable / SetGloballyDurable /
+QueryDurableBefore (messages/SetShardDurable.java etc., feeding
+local/DurableBefore.java:39): after a durability round's ExclusiveSyncPoint
+reaches an applied quorum, every replica learns that ids below the sync point
+are majority-durable (enabling truncation); a global round aggregates every
+node's majority floor into the universal floor.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from accord_tpu.messages.base import Reply, Request
+from accord_tpu.primitives.keyspace import Ranges
+from accord_tpu.primitives.timestamp import TxnId
+
+
+class SetShardDurable(Request):
+    def __init__(self, sync_id: TxnId, ranges: Ranges):
+        self.sync_id = sync_id
+        self.ranges = ranges
+        self.wait_for_epoch = sync_id.epoch
+
+    def process(self, node, from_node, reply_context) -> None:
+        for s in node.command_stores.all():
+            if s.owns(self.ranges):
+                s.mark_shard_durable(self.sync_id, self.ranges)
+        node.reply(from_node, reply_context, DurableAck(self.sync_id))
+
+    def __repr__(self):
+        return f"SetShardDurable({self.sync_id!r}, {self.ranges!r})"
+
+
+class DurableAck(Reply):
+    __slots__ = ("sync_id",)
+
+    def __init__(self, sync_id: TxnId):
+        self.sync_id = sync_id
+
+    def __repr__(self):
+        return f"DurableAck({self.sync_id!r})"
+
+
+class QueryDurableBefore(Request):
+    """Collect this node's majority-durable floor segments (for the global
+    aggregation round)."""
+
+    def __init__(self):
+        self.wait_for_epoch = 0
+
+    @property
+    def has_side_effects(self) -> bool:
+        return False
+
+    def process(self, node, from_node, reply_context) -> None:
+        segments: List[Tuple] = []
+        for s in node.command_stores.all():
+            for start, end, ts in s.durable_majority.segments():
+                if ts is not None:
+                    segments.append((start, end, ts))
+        node.reply(from_node, reply_context, DurableBeforeOk(segments))
+
+    def __repr__(self):
+        return "QueryDurableBefore()"
+
+
+class DurableBeforeOk(Reply):
+    __slots__ = ("segments",)
+
+    def __init__(self, segments: List[Tuple]):
+        self.segments = segments  # [(start, end, ts)]
+
+    def __repr__(self):
+        return f"DurableBeforeOk({len(self.segments)} segments)"
+
+
+class SetGloballyDurable(Request):
+    """The cluster-wide min of every node's majority floor: ids below it are
+    applied at EVERY replica."""
+
+    def __init__(self, segments: List[Tuple]):
+        self.segments = segments
+        self.wait_for_epoch = 0
+
+    def process(self, node, from_node, reply_context) -> None:
+        for s in node.command_stores.all():
+            s.mark_globally_durable(self.segments)
+        node.reply(from_node, reply_context, DurableAck(None))
+
+    def __repr__(self):
+        return f"SetGloballyDurable({len(self.segments)} segments)"

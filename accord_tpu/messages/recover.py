@@ -67,7 +67,14 @@ class BeginRecovery(Request):
                 return RecoverNack(self.txn_id,
                                    store.command(self.txn_id).promised)
             if outcome == AcceptOutcome.TRUNCATED:
-                return RecoverNack(self.txn_id, None)
+                # the record is gone but its outcome was durable: answer with
+                # TRUNCATED status (counts toward the quorum) so recovery can
+                # prefer informative replies and only conclude TRUNCATED when
+                # nothing better exists anywhere (reference:
+                # Recover.java:252-254 maxAcceptedNotTruncated)
+                return RecoverOk(self.txn_id, Status.TRUNCATED, Ballot.ZERO,
+                                 None, (), Deps.NONE, Deps.NONE, False,
+                                 None, None)
 
             cmd = store.command(self.txn_id)
             entries: List[DepsEntry] = []
@@ -97,6 +104,12 @@ class BeginRecovery(Request):
         def reduce_fn(a, b):
             if isinstance(a, RecoverNack) or isinstance(b, RecoverNack):
                 return a if isinstance(a, RecoverNack) else b
+            # a truncated store contributes nothing; prefer informative state
+            # from a sibling store (its knowledge covers its own ranges)
+            if a.status == Status.TRUNCATED and b.status != Status.TRUNCATED:
+                return b
+            if b.status == Status.TRUNCATED and a.status != Status.TRUNCATED:
+                return a
             # keep the decision of the most advanced store (phase, then ballot
             # within the Accept phase: an accepted invalidation at a higher
             # ballot must surface over a stale acceptance); witnessed
@@ -385,6 +398,14 @@ class CheckStatus(Request):
     def process(self, node, from_node, reply_context) -> None:
         def map_fn(store):
             cmd = store.command_if_present(self.txn_id)
+            if cmd is None or cmd.status == Status.NOT_DEFINED:
+                # an empty record may be a RE-CREATED one (a waiter's
+                # _init_waiting_on resurrects dropped deps): the truncation
+                # horizon, not the record, is the truth for below-floor ids
+                if store.is_truncated(self.txn_id, self.participants):
+                    return CheckStatusOk(self.txn_id, Status.TRUNCATED,
+                                         Ballot.ZERO, None, None, None, None,
+                                         None, None)
             if cmd is None:
                 return CheckStatusOk(self.txn_id, Status.NOT_DEFINED,
                                      Ballot.ZERO, None, None, None, None,

@@ -63,7 +63,10 @@ class TimestampEncoder:
                 raise ValueError(f"timestamp {ts} outside encoder window")
             out[i, 0] = ts.epoch - self.base_epoch
             out[i, 1] = ts.hlc - self.base_hlc
-            out[i, 2] = (ts.flags << 16) | ts.node
+            # biased so the full 32-bit (flags, node) space -- including the
+            # REJECTED flag in bit 15 of flags -- fits a SIGNED int32 lane
+            # while preserving order
+            out[i, 2] = ((ts.flags << 16) | ts.node) - (1 << 31)
         return out
 
 

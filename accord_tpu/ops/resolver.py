@@ -55,6 +55,9 @@ class DepsResolver:
         unsupported here -- ask the host scan."""
         return False, None
 
+    def on_truncate(self, store, txn_id: TxnId) -> None:
+        """Observer hook: the store truncated this txn's local record."""
+
 
 class HostDepsResolver(DepsResolver):
     def resolve_one(self, store, txn_id, seekables, before) -> Deps:
@@ -227,6 +230,17 @@ class BatchDepsResolver(DepsResolver):
             return  # range-domain txns stay host-side
         st = self._state(store)
         st.update(txn_id, tuple(sorted(keys)), status, witnessed_at)
+
+    def on_truncate(self, store, txn_id: TxnId) -> None:
+        st = self._states.get(id(store))
+        if st is None:
+            return
+        row = st.row_of.get(txn_id)
+        if row is not None:
+            # deps must stop including it (the host cfk scan no longer does);
+            # exec_ts stays -- MaxConflicts is monotone
+            st.valid[row] = False
+            st._dirty_rows.add(row)
 
     # -- SPI ----------------------------------------------------------------
     def resolve_one(self, store, txn_id, seekables, before) -> Deps:

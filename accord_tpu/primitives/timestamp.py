@@ -103,22 +103,22 @@ _WITNESSES = {
 class Timestamp:
     """(epoch, hlc, flags, node) with total order. Immutable."""
 
-    __slots__ = ("epoch", "hlc", "flags", "node", "_cmp")
+    __slots__ = ("epoch", "hlc", "flags", "node", "_cmp", "_hash")
 
     def __init__(self, epoch: int, hlc: int, flags: int, node: NodeId):
-        assert 0 <= epoch < (1 << _EPOCH_BITS)
-        assert 0 <= hlc < (1 << _HLC_BITS)
-        assert 0 <= flags < (1 << _FLAGS_BITS)
-        assert 0 <= node < (1 << _NODE_BITS)
+        # bounds are enforced where values originate (unique_now, create,
+        # unpack); re-validating on every wire-decode reconstruction is one
+        # of the simulator's top costs
         object.__setattr__(self, "epoch", epoch)
         object.__setattr__(self, "hlc", hlc)
         object.__setattr__(self, "flags", flags)
         object.__setattr__(self, "node", node)
         # one order-preserving int for the (epoch, hlc, flags, node) total
         # order: comparisons and hashing are the simulator's hottest ops
-        object.__setattr__(self, "_cmp",
-                           (((epoch << _HLC_BITS) | hlc) << (_FLAGS_BITS + _NODE_BITS))
-                           | (flags << _NODE_BITS) | node)
+        cmp = (((epoch << _HLC_BITS) | hlc) << (_FLAGS_BITS + _NODE_BITS)) \
+            | (flags << _NODE_BITS) | node
+        object.__setattr__(self, "_cmp", cmp)
+        object.__setattr__(self, "_hash", hash(cmp))
 
     def __setattr__(self, *a):
         raise AttributeError("immutable")
@@ -149,7 +149,7 @@ class Timestamp:
         return isinstance(other, Timestamp) and self._cmp == other._cmp
 
     def __hash__(self) -> int:
-        return hash(self._cmp)
+        return self._hash
 
     # -- rejection flag (reference: Timestamp.REJECTED_FLAG / asRejected) ----
     @property

@@ -18,6 +18,7 @@ import json
 import sys
 from typing import Dict, List, Optional, Tuple
 
+from accord_tpu.coordinate.errors import Invalidated
 from accord_tpu.primitives.keyspace import Keys
 from accord_tpu.primitives.timestamp import Domain, TxnKind
 from accord_tpu.primitives.txn import Txn
@@ -45,6 +46,7 @@ class BurnReport:
 def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
              key_count: int = 32, concurrency: int = 8,
              write_ratio: float = 0.7, max_keys_per_txn: int = 3,
+             zipf_theta: float = 0.0,
              chaos_drop: float = 0.0, chaos_partitions: bool = False,
              topology_churn: bool = False, churn_interval_ms: float = 1000.0,
              config: Optional[ClusterConfig] = None,
@@ -57,12 +59,19 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
     report = BurnReport()
     state = {"submitted": 0, "completed": 0, "next_value": 1}
 
-    # keys drawn zipfian from a small hot set spread over the hash domain
+    # keys drawn from a hot set spread over the hash domain; zipf_theta > 0
+    # skews picks toward the head (the contended-throughput bench shape)
     key_space = sorted(wl_rng.sample(range(cfg.key_domain), key_count))
+    if zipf_theta > 0.0:
+        def pick_key():
+            return key_space[wl_rng.zipf(len(key_space), zipf_theta)]
+    else:
+        def pick_key():
+            return wl_rng.pick(key_space)
 
     def gen_txn() -> Tuple[Txn, Optional[int], Dict]:
         nkeys = wl_rng.next_int_between(1, max_keys_per_txn + 1)
-        chosen = Keys(wl_rng.pick(key_space) for _ in range(nkeys))
+        chosen = Keys(pick_key() for _ in range(nkeys))
         is_write = wl_rng.decide(write_ratio)
         read = ListRead(chosen)
         if is_write:
@@ -79,22 +88,33 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
             return
         state["submitted"] += 1
         txn, value, writes = gen_txn()
-        node = cluster.nodes[1 + wl_rng.next_int(cfg.num_nodes)]
         start_us = cluster.queue.now_micros
         if value is not None:
             verifier.on_issue_write(value, start_us)
+        attempt(txn, value, writes, start_us, retries=3)
+
+    def attempt(txn, value, writes, start_us, retries):
+        node = cluster.nodes[1 + wl_rng.next_int(cfg.num_nodes)]
 
         def complete(result, failure):
-            state["completed"] += 1
             end_us = cluster.queue.now_micros
             if failure is None:
+                state["completed"] += 1
                 report.acked += 1
                 assert isinstance(result, ListResult)
                 verifier.witness(start_us, end_us, result.reads, writes)
                 if collect_log:
                     report.log.append(
                         f"{end_us} ack {result.txn_id} reads={sorted(result.reads.items())} w={value}")
+            elif isinstance(failure, Invalidated) and retries > 0:
+                # an invalidation PROVES the txn never executed and never
+                # will (e.g. it raced a durability sync point's reject
+                # floor): retrying with a fresh txn id is always safe --
+                # unlike a timeout, whose outcome is unknown
+                attempt(txn, value, writes, start_us, retries - 1)
+                return
             else:
+                state["completed"] += 1
                 report.failed += 1
                 if collect_log:
                     report.log.append(f"{end_us} fail {type(failure).__name__} w={value}")
@@ -145,6 +165,10 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
         TopologyRandomizer(cluster, cluster.rng.fork(),
                            interval_us=int(churn_interval_ms * 1000),
                            should_stop=lambda: state["completed"] >= ops).start()
+
+    if cfg.durability:
+        cluster.start_durability(
+            should_stop=lambda: state["completed"] >= ops)
 
     # kick off with bounded concurrency
     for i in range(min(concurrency, ops)):

@@ -28,7 +28,8 @@ class ClusterConfig:
                  timeout_ms: float = 1000.0, deps_resolver_factory=None,
                  deps_batch_window_ms=0.0,
                  progress: bool = True, progress_interval_ms: float = 250.0,
-                 progress_stall_ms: float = 1500.0, serialize: bool = True):
+                 progress_stall_ms: float = 1500.0, serialize: bool = True,
+                 durability: bool = False, durability_interval_ms: float = 500.0):
         self.num_nodes = num_nodes
         self.rf = min(rf, num_nodes)
         self.num_shards = num_shards
@@ -42,6 +43,10 @@ class ClusterConfig:
         self.progress_interval_ms = progress_interval_ms
         self.progress_stall_ms = progress_stall_ms
         self.serialize = serialize  # wire-codec round-trip for every message
+        # background durability rounds (CoordinateShardDurable rotation);
+        # the burn enables them and stops them at workload completion
+        self.durability = durability
+        self.durability_interval_ms = durability_interval_ms
 
 
 def build_topology(cfg: ClusterConfig, epoch: int = 1) -> Topology:
@@ -192,6 +197,19 @@ class Cluster:
             self.nodes[node_id] = node
             self.stores[node_id] = store
             self.network.register_node(node)
+        self.durability_schedulers = []
+
+    def start_durability(self, should_stop=None) -> None:
+        """Start background durability rotation on every node. The caller
+        supplies should_stop so a simulated run can quiesce (a recurring task
+        with no stop condition would keep the event queue alive forever)."""
+        from accord_tpu.impl.durability import DurabilityScheduling
+        for node in self.nodes.values():
+            sched = DurabilityScheduling(
+                node, interval_ms=self.config.durability_interval_ms,
+                should_stop=should_stop)
+            sched.start()
+            self.durability_schedulers.append(sched)
 
     def node(self, node_id: NodeId) -> Node:
         return self.nodes[node_id]

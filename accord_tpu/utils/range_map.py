@@ -149,6 +149,26 @@ def merge(a: ReducingRangeMap, b: ReducingRangeMap, reduce: Callable) -> Reducin
     return _normalize(bounds, values)
 
 
+def min_intersection(a: ReducingRangeMap, b: ReducingRangeMap) -> ReducingRangeMap:
+    """Pointwise min where BOTH maps have a value; absent anywhere either is
+    absent (unlike merge(), which fills gaps from the other map). Used for
+    truncation floors: state may only be truncated where it is both locally
+    redundant AND durable."""
+    if a.is_empty() or b.is_empty():
+        return ReducingRangeMap.EMPTY
+    points: List[Any] = sorted(set(a.bounds) | set(b.bounds))
+    bounds: List[Any] = []
+    values: List[Any] = []
+    for i in range(len(points) - 1):
+        lo = points[i]
+        av, bv = a.get(lo), b.get(lo)
+        v = min(av, bv) if av is not None and bv is not None else None
+        bounds.append(lo)
+        values.append(v)
+    bounds.append(points[-1])
+    return _normalize(bounds, values)
+
+
 def _normalize(bounds: List[Any], values: List[Any]) -> ReducingRangeMap:
     """Drop leading/trailing None segments and merge equal neighbours."""
     nb: List[Any] = []
