@@ -655,7 +655,29 @@ class MaybeRecover(Callback):
         if failure is not None:
             self.result.try_set_failure(failure)
         else:
+            if value is Outcome.TRUNCATED:
+                # a full Recover concluded every reachable replica truncated
+                # the record (outcome universally durable + erased): mark our
+                # local records truncated too, so dependents drop their wait
+                # edges instead of probing forever
+                self._mark_local_truncated(self.participants)
             self.result.try_set_success(value)
+
+    def _mark_local_truncated(self, scope) -> None:
+        from accord_tpu.local import commands as _commands
+        from accord_tpu.local.status import Status as _S
+        for store in self.node.command_stores.all():
+            if not store.owns(scope):
+                continue
+            cmd = store.command_if_present(self.txn_id)
+            if cmd is None or cmd.status.is_terminal \
+                    or cmd.has_been(_S.APPLIED):
+                continue
+            if self.txn_id.kind.is_write:
+                store.mark_gap(_to_ranges(store.owned(scope)))
+            cmd.status = _S.TRUNCATED
+            _commands.notify_listeners(store, cmd)
+            store.progress_log.clear(self.txn_id)
 
     # -- Propagate (reference: messages/Propagate.java:64) -------------------
     def _propagate_invalidate(self, merged: Optional[CheckStatusOk] = None) -> None:
@@ -673,23 +695,9 @@ class MaybeRecover(Callback):
         it any more. Mark local records truncated (dependents drop the edge);
         a local replica that never applied a truncated WRITE has a data gap --
         its copy can only be repaired by a fresh bootstrap snapshot."""
-        from accord_tpu.local import commands as _commands
-        from accord_tpu.local.status import Status as _S
         scope = merged.route.participants if merged.route is not None \
             else self.participants
-        for store in self.node.command_stores.all():
-            if not store.owns(scope):
-                continue
-            cmd = store.command_if_present(self.txn_id)
-            if cmd is None or cmd.status.is_terminal \
-                    or cmd.has_been(_S.APPLIED):
-                continue
-            if self.txn_id.kind.is_write:
-                owned = store.owned(scope)
-                store.mark_gap(_to_ranges(owned))
-            cmd.status = _S.TRUNCATED
-            _commands.notify_listeners(store, cmd)
-            store.progress_log.clear(self.txn_id)
+        self._mark_local_truncated(scope)
         self.result.try_set_success(Outcome.TRUNCATED)
 
     def _propagate_outcome(self, merged: CheckStatusOk) -> None:

@@ -11,8 +11,9 @@ A shard-durable round: coordinate an ExclusiveSyncPoint over a shard's range,
 wait for an APPLIED quorum (everything ordered below the sync point is then
 applied at a quorum), then broadcast SetShardDurable so every replica advances
 its majority floor and truncates. A global round aggregates every replica's
-majority floor into the universal floor via QueryDurableBefore /
-SetGloballyDurable.
+locally-APPLIED floor (redundant_before) into the universal floor via
+QueryDurableBefore / SetGloballyDurable -- only below the min over every
+replica is an outcome erasable (see QueryDurableBefore doc).
 """
 from __future__ import annotations
 
@@ -22,6 +23,7 @@ from accord_tpu.coordinate.syncpoint import CoordinateSyncPoint
 from accord_tpu.messages.base import Callback
 from accord_tpu.messages.durability import (
     DurableBeforeOk, QueryDurableBefore, SetGloballyDurable, SetShardDurable,
+    applied_floor_segments,
 )
 from accord_tpu.primitives.keyspace import Ranges
 from accord_tpu.primitives.timestamp import Timestamp
@@ -74,12 +76,7 @@ class CoordinateGloballyDurable(Callback):
         self = cls(node)
         for to in sorted(self.pending):
             if to == node.id:
-                segs = []
-                for s in node.command_stores.all():
-                    for start, end, ts in s.durable_majority.segments():
-                        if ts is not None:
-                            segs.append((start, end, ts))
-                self.replies[to] = DurableBeforeOk(segs)
+                self.replies[to] = DurableBeforeOk(applied_floor_segments(node))
                 self.pending.discard(to)
             else:
                 node.send(to, QueryDurableBefore(), self)

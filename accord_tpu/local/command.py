@@ -57,6 +57,7 @@ class Command:
         "txn_id", "status", "durability", "promised", "accepted_ballot",
         "execute_at", "txn", "route", "deps", "writes", "result",
         "waiting_on", "waiters", "transient_listeners", "elision_floor_cache",
+        "cleaned",
     )
 
     def __init__(self, txn_id: TxnId):
@@ -77,6 +78,11 @@ class Command:
         self.transient_listeners: List[TransientListener] = []
         # (bootstrapped_at map identity, floor) memo for dep elision
         self.elision_floor_cache = None
+        # tier-A truncation (reference: Cleanup.TRUNCATE_WITH_OUTCOME): the
+        # conflict-registry entries and deps were dropped, but the outcome
+        # (txn/executeAt/writes/result) is retained so straggler replicas can
+        # still repair from us until the outcome is universally durable
+        self.cleaned = False
 
     # -- knowledge predicates (the reference's Known vector) ----------------
     def has_been(self, status: Status) -> bool:

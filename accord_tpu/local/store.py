@@ -315,6 +315,7 @@ class CommandStore:
         for start, end, ts in segments:
             self.durable_universal = self.durable_universal.with_range(
                 start, end, ts, Timestamp.merge_max)
+        self.cleanup()
 
     def is_truncated(self, txn_id: TxnId, seekables: Seekables) -> bool:
         """Was this txn's local record truncated? (Any owned part below the
@@ -333,69 +334,104 @@ class CommandStore:
                 r.start, r.end, lambda acc, f: acc or ts < f, hit)
         return hit
 
+    def _below_floor(self, cmd, floor_map: ReducingRangeMap) -> bool:
+        """Is every owned key/range of `cmd` covered by a floor segment above
+        its id? (A command with no definition -- a blind invalidation --
+        requires the WHOLE owned slice floored, else such records accumulate
+        forever under chaos.)"""
+        from accord_tpu.local.status import Status as _S
+        ts = cmd.txn_id.as_timestamp()
+        keys = cmd.txn.keys if cmd.txn is not None else None
+        if keys is None:
+            return cmd.is_(_S.INVALIDATED) and all(
+                floor_map.covers(r.start, r.end, lambda f: ts < f)
+                for r in self.ranges)
+        owned = self.owned(keys)
+        if isinstance(owned, Keys):
+            return len(owned) > 0 and all(
+                (f := floor_map.get(k)) is not None and ts < f
+                for k in owned)
+        return not owned.is_empty() and all(
+            floor_map.covers(r.start, r.end, lambda f: ts < f)
+            for r in _as_ranges(owned))
+
     def cleanup(self) -> None:
-        """Truncate per-txn state below min(durable_majority, redundant_before):
-        state both locally redundant (every conflicting txn below the floor
-        has applied here) AND majority-durable may be dropped; probes for it
-        answer TRUNCATED (reference: Cleanup deciding the erase level). The
-        floor is an ExclusiveSyncPoint id, and the LATEST sync point is never
-        below its own floor, so it survives to carry the transitive ordering
-        edge for laggards."""
-        from accord_tpu.utils.range_map import min_intersection
-        floor_map = min_intersection(self.durable_majority, self.redundant_before)
-        if floor_map.is_empty():
+        """Two truncation tiers (reference: local/Cleanup.java deciding the
+        erase level, Commands.purge):
+
+        TIER A, *shrink* (reference TRUNCATE_WITH_OUTCOME), below
+        min(durable_majority, redundant_before): the conflict-registry entries
+        (cfk rows, device lanes) are dropped -- bounding the deps scans -- but
+        the Command record RETAINS its outcome (txn, executeAt, deps, writes,
+        result). A straggler replica not in the applied quorum can still
+        repair from a CheckStatus probe, and needs the retained deps to order
+        the replayed applies; erasing outcomes at mere majority durability
+        would strand it forever (the round-2 no-quiescence liveness bug).
+
+        TIER B, *erase*, below min(durable_universal, redundant_before): every
+        replica has applied it, so nobody can ever need the outcome again --
+        drop the record and advance the truncation horizon; probes answer
+        TRUNCATED. The floor is an ExclusiveSyncPoint id, and the LATEST sync
+        point is never below its own floor, so it survives to carry the
+        transitive ordering edge for laggards."""
+        from accord_tpu.utils.range_map import merge as _merge, min_intersection
+        # the two tiers are independent: a replica that missed the one-shot
+        # SetShardDurable broadcast (empty majority floor) must still erase
+        # when the universal floor reaches it
+        shrink_floor = min_intersection(self.durable_majority, self.redundant_before)
+        erase_floor = min_intersection(self.durable_universal, self.redundant_before)
+        if shrink_floor.is_empty() and erase_floor.is_empty():
             return
         from accord_tpu.local.status import Status as _S
-        dropped = []
+        erased = []
         for txn_id, cmd in self.commands.items():
             if not (cmd.has_been(_S.APPLIED) or cmd.is_(_S.INVALIDATED)):
                 continue
             if cmd.waiters:
                 continue  # someone still watches it; let them resolve first
-            keys = cmd.txn.keys if cmd.txn is not None else None
-            ts = txn_id.as_timestamp()
-            if keys is None:
-                # blind invalidation (never witnessed here, no definition):
-                # droppable once the WHOLE owned slice is floored above it,
-                # else these records accumulate forever under chaos
-                if cmd.is_(_S.INVALIDATED) and all(
-                        floor_map.covers(r.start, r.end, lambda f: ts < f)
-                        for r in self.ranges):
-                    dropped.append(txn_id)
-                continue
-            owned = self.owned(keys)
-            if isinstance(owned, Keys):
-                if len(owned) == 0 or not all(
-                        (f := floor_map.get(k)) is not None and ts < f
-                        for k in owned):
-                    continue
-            else:
-                if owned.is_empty() or not all(
-                        floor_map.covers(r.start, r.end, lambda f: ts < f)
-                        for r in _as_ranges(owned)):
-                    continue
-            dropped.append(txn_id)
-        for txn_id in dropped:
+            if not erase_floor.is_empty() and self._below_floor(cmd, erase_floor):
+                erased.append(txn_id)
+            elif not cmd.cleaned and not shrink_floor.is_empty() \
+                    and self._below_floor(cmd, shrink_floor):
+                self._shrink(cmd)
+        for txn_id in erased:
             cmd = self.commands.pop(txn_id)
-            if cmd.txn is not None:
-                owned = self.owned(cmd.txn.keys)
-                if isinstance(owned, Keys):
-                    for k in owned:
-                        c = self.cfks.get(k)
-                        if c is not None:
-                            c.remove(txn_id)
-                            if c.is_empty():
-                                del self.cfks[k]
-            self.range_txns.pop(txn_id, None)
-            if self.deps_resolver is not None:
-                self.deps_resolver.on_truncate(self, txn_id)
+            if not cmd.cleaned:
+                self._deregister(cmd)
             self.progress_log.clear(txn_id)
-        # advance the truncation horizon over the whole floored region: ids
-        # below it either applied durably, were invalidated, or can never
-        # commit (the sync point's reject floor covers new arrivals)
-        from accord_tpu.utils.range_map import merge as _merge
-        self.truncated_before = _merge(self.truncated_before, floor_map,
-                                       Timestamp.merge_max)
+        if not erase_floor.is_empty():
+            # advance the truncation horizon over the whole erased region: ids
+            # below it either applied durably, were invalidated, or can never
+            # commit (the sync point's reject floor covers new arrivals)
+            self.truncated_before = _merge(self.truncated_before, erase_floor,
+                                           Timestamp.merge_max)
+
+    def _shrink(self, cmd) -> None:
+        # deps are RETAINED: a straggler repairing its copy from our
+        # CheckStatus reply needs them to order the replayed applies (writes
+        # applied dep-free would interleave out of order); the record (deps
+        # included) is reclaimed at tier B once no straggler can exist
+        self._deregister(cmd)
+        cmd.waiting_on = None
+        cmd.cleaned = True
+        self.progress_log.clear(cmd.txn_id)
+
+    def _deregister(self, cmd) -> None:
+        """Drop a command's conflict-registry footprint (cfk rows, range
+        registration, device active-set lane)."""
+        txn_id = cmd.txn_id
+        if cmd.txn is not None:
+            owned = self.owned(cmd.txn.keys)
+            if isinstance(owned, Keys):
+                for k in owned:
+                    c = self.cfks.get(k)
+                    if c is not None:
+                        c.remove(txn_id)
+                        if c.is_empty():
+                            del self.cfks[k]
+        self.range_txns.pop(txn_id, None)
+        if self.deps_resolver is not None:
+            self.deps_resolver.on_truncate(self, txn_id)
 
     # -- bootstrap floor (reference: local/Bootstrap.java:81 doc :28-80) -----
     def set_bootstrap_floor(self, sync_id: TxnId, ranges: Ranges) -> None:
@@ -530,8 +566,71 @@ class CommandStore:
         (reference: PreAccept.calculatePartialDeps, messages/PreAccept.java:245).
         Delegates to the DepsResolver SPI when installed (TPU path)."""
         if self.deps_resolver is not None:
-            return self.deps_resolver.resolve_one(self, txn_id, seekables, before)
-        return self.host_calculate_deps(txn_id, seekables, before)
+            raw = self.deps_resolver.resolve_one(self, txn_id, seekables, before)
+        else:
+            raw = self.host_calculate_deps(txn_id, seekables, before)
+        return self.inject_dep_floor(txn_id, seekables, raw)
+
+    def inject_dep_floor(self, txn_id: TxnId, seekables: Seekables,
+                         deps: Deps) -> Deps:
+        """Replace deps below the locally-applied ExclusiveSyncPoint floor
+        with a single dep on the floor ESP itself (reference:
+        RedundantBefore.collectDeps, local/RedundantBefore.java:49): the ESP
+        witnessed and waited out everything below it, so one edge to it
+        carries the same ordering with O(1) size. This is what keeps dep sets
+        bounded by the inter-durability-round arrival rate instead of the
+        total live-txn count."""
+        rb = self.redundant_before
+        if rb.is_empty():
+            return deps
+        owned = self.owned(seekables)
+        kb = KeyDepsBuilder()
+        rbld = RangeDepsBuilder()
+        if isinstance(owned, Keys):
+            floors = {}
+            for k in owned:
+                f = rb.get(k)
+                if f is not None:
+                    floors[k] = f
+            if not floors:
+                return deps
+            for k, ids in deps.key_deps.items():
+                f = floors.get(k)
+                if f is None:
+                    kb.add_all(k, ids)
+                else:
+                    kb.add_all(k, [t for t in ids if not t < f])
+            for k, f in floors.items():
+                fid = TxnId.from_timestamp(f)
+                if fid != txn_id:
+                    kb.add(k, fid)
+            # key subjects carry no range rows of their own; pass them through
+            for r, ids in deps.range_deps.items():
+                rbld.add_all(r, ids)
+        else:
+            for r, ids in deps.range_deps.items():
+                fmin = None
+                if rb.covers(r.start, r.end, lambda v: True):
+                    fmin = rb.fold_over_range(
+                        r.start, r.end,
+                        lambda acc, v: v if acc is None or v < acc else acc,
+                        None)
+                kept = ids if fmin is None else [t for t in ids if not t < fmin]
+                if kept:
+                    rbld.add_all(r, kept)
+            for rr in _as_ranges(owned):
+                for s, e, f in rb.segments():
+                    lo, hi = max(s, rr.start), min(e, rr.end)
+                    if lo < hi and f is not None:
+                        fid = TxnId.from_timestamp(f)
+                        if fid != txn_id:
+                            rbld.add(Range(lo, hi), fid)
+            for k, ids in deps.key_deps.items():
+                f = rb.get(k)
+                kept = ids if f is None else [t for t in ids if not t < f]
+                if kept:
+                    kb.add_all(k, kept)
+        return Deps(kb.build(), rbld.build())
 
     def calculate_deps_async(self, txn_id: TxnId, seekables: Seekables,
                              before: Timestamp) -> AsyncResult:
@@ -644,12 +743,12 @@ class CommandStore:
             if need_host_ranges:
                 deps = deps.union(self.host_range_deps(
                     t, self.owned(p.keys), w))
-            out.try_set_success((oc, w, deps))
+            out.try_set_success((oc, w, self.inject_dep_floor(t, p.keys, deps)))
         for (t, ks, before, out) in deps_batch:
             deps = next(it)
             if need_host_ranges:
                 deps = deps.union(self.host_range_deps(t, self.owned(ks), before))
-            out.try_set_success(deps)
+            out.try_set_success(self.inject_dep_floor(t, ks, deps))
 
     def _drain_deps_queue(self, deps_batch) -> None:
         subjects = [(t, self.owned(ks), before)
@@ -659,7 +758,7 @@ class CommandStore:
         for (t, ks, before, out), deps in zip(deps_batch, rows):
             if need_host_ranges:
                 deps = deps.union(self.host_range_deps(t, self.owned(ks), before))
-            out.try_set_success(deps)
+            out.try_set_success(self.inject_dep_floor(t, ks, deps))
 
     def host_range_deps(self, txn_id: TxnId, seekables: Seekables,
                         before: Timestamp) -> Deps:

@@ -42,9 +42,26 @@ class DurableAck(Reply):
         return f"DurableAck({self.sync_id!r})"
 
 
+def applied_floor_segments(node) -> List[Tuple]:
+    """This node's locally-APPLIED floor segments [(start, end, ts)] across
+    its stores (redundant_before): the input to the universal-floor min.
+    Shared by the QueryDurableBefore handler and the global coordinator's
+    self-reply so the two can never diverge."""
+    segments: List[Tuple] = []
+    for s in node.command_stores.all():
+        for start, end, ts in s.redundant_before.segments():
+            if ts is not None:
+                segments.append((start, end, ts))
+    return segments
+
+
 class QueryDurableBefore(Request):
-    """Collect this node's majority-durable floor segments (for the global
-    aggregation round)."""
+    """Collect this node's LOCALLY-APPLIED floor segments (redundant_before:
+    everything below an ExclusiveSyncPoint this replica has itself applied).
+    The global round takes the per-shard min over replicas: only below that is
+    an outcome applied at EVERY replica and safe to erase. Aggregating
+    majority floors here instead was the round-2 liveness bug -- replicas
+    erased outcomes a straggler still needed."""
 
     def __init__(self):
         self.wait_for_epoch = 0
@@ -54,12 +71,8 @@ class QueryDurableBefore(Request):
         return False
 
     def process(self, node, from_node, reply_context) -> None:
-        segments: List[Tuple] = []
-        for s in node.command_stores.all():
-            for start, end, ts in s.durable_majority.segments():
-                if ts is not None:
-                    segments.append((start, end, ts))
-        node.reply(from_node, reply_context, DurableBeforeOk(segments))
+        node.reply(from_node, reply_context,
+                   DurableBeforeOk(applied_floor_segments(node)))
 
     def __repr__(self):
         return "QueryDurableBefore()"
@@ -76,8 +89,8 @@ class DurableBeforeOk(Reply):
 
 
 class SetGloballyDurable(Request):
-    """The cluster-wide min of every node's majority floor: ids below it are
-    applied at EVERY replica."""
+    """The per-shard min of every replica's locally-applied floor: ids below
+    it are applied at EVERY replica (so their records may be erased)."""
 
     def __init__(self, segments: List[Tuple]):
         self.segments = segments

@@ -37,6 +37,10 @@ def _run_shard_durable(cluster, node, ranges):
 
 
 def test_shard_durable_round_advances_floor_and_truncates():
+    """Two truncation tiers (reference: Cleanup.TRUNCATE_WITH_OUTCOME vs
+    ERASE): a shard-durable round SHRINKS records (conflict-registry footprint
+    dropped, outcome retained for straggler repair); only a global round
+    (universal durability) ERASES them."""
     cluster = Cluster(71, ClusterConfig())
     keys = Keys([100, 200])
     ids = []
@@ -57,12 +61,30 @@ def test_shard_durable_round_advances_floor_and_truncates():
                 continue
             # majority floor advanced to the sync point
             assert s.durable_majority.get(100) == sync_id.as_timestamp()
-            # the applied writes below the floor were truncated
+            # tier A: applied writes below the floor were shrunk -- cfk rows
+            # gone, outcome retained (a straggler may still need it)
+            for t in ids:
+                cmd = s.command_if_present(t)
+                assert cmd is not None and cmd.cleaned, \
+                    f"{t} not shrunk on node {nid}"
+                assert cmd.writes is not None
+                c = s.cfks.get(100)
+                assert c is None or c.get(t) is None
+        assert cluster.stores[nid].snapshot(100) == (1, 2, 3)
+
+    # tier B: a global round erases the records everywhere
+    g = CoordinateGloballyDurable.run(cluster.nodes[1])
+    cluster.drain()
+    cluster.check_no_failures()
+    assert g.done and g.failure is None
+    for nid in shard0.nodes:
+        for s in cluster.nodes[nid].command_stores.all():
+            if not s.ranges.contains_key(100):
+                continue
             for t in ids:
                 assert s.command_if_present(t) is None, \
-                    f"{t} not truncated on node {nid}"
+                    f"{t} not erased on node {nid}"
                 assert s.is_truncated(t, keys)
-            # the data itself is intact
         assert cluster.stores[nid].snapshot(100) == (1, 2, 3)
 
 
@@ -75,6 +97,10 @@ def test_recovery_of_truncated_txn_returns_truncated():
     txn_id = res.value().txn_id
     shard0 = cluster.current_topology().shards[0]
     _run_shard_durable(cluster, cluster.nodes[1], Ranges.of(shard0.range))
+    # erasure requires universal durability (a global round)
+    CoordinateGloballyDurable.run(cluster.nodes[1])
+    cluster.drain()
+    cluster.check_no_failures()
 
     # every replica truncated it; a full recovery must conclude TRUNCATED,
     # not invalidate or re-propose (ADVICE round-1 low item)
@@ -135,6 +161,53 @@ def test_burn_state_plateaus_with_durability():
     # with it, the steady-state level is set by the round interval, not ops
     assert totals[600] < totals[300] * 1.5, f"no plateau: {totals}"
     assert totals[600] < 600 * 3, "residual exceeds untruncated floor"
+
+
+def test_durability_burn_liveness_seed74():
+    """Round-2 regression: seed 74, ops=100 ground to 'no quiescence after
+    2000000 events'. Root cause: records were ERASED at majority durability,
+    so a straggler replica that missed an Apply could never repair its copy
+    (probes found only outcome-less TRUNCATED answers) and every later txn +
+    durability sync point chained behind it forever. Erasure now requires
+    universal durability; outcome-retaining shrink covers the majority tier."""
+    for ops in (100, 150):
+        r = run_burn(74, ops=ops,
+                     config=ClusterConfig(durability=True,
+                                          durability_interval_ms=250.0))
+        assert r.acked == ops and r.lost == 0
+
+
+def test_deps_stay_bounded_with_durability():
+    """The dep-floor injection (reference: RedundantBefore.collectDeps):
+    deps sets must be bounded by the inter-durability-round arrival rate,
+    not the total number of live txns."""
+    import accord_tpu.sim.burn as burn_mod
+    from accord_tpu.sim.cluster import Cluster as RealCluster
+    captured = []
+
+    class SpyCluster(RealCluster):
+        def __init__(self, *a, **k):
+            super().__init__(*a, **k)
+            captured.append(self)
+
+    orig = burn_mod.Cluster
+    burn_mod.Cluster = SpyCluster
+    try:
+        r = run_burn(74, ops=600,
+                     config=ClusterConfig(durability=True,
+                                          durability_interval_ms=250.0))
+        assert r.acked == 600
+        worst = 0
+        for n in captured[0].nodes.values():
+            for s in n.command_stores.all():
+                for cmd in s.commands.values():
+                    if cmd.deps is not None:
+                        worst = max(worst, len(cmd.deps.all_txn_ids()))
+        # without floor injection the worst sync-point deps enumerate every
+        # live txn (hundreds); with it they track the per-round arrival rate
+        assert worst < 120, f"deps not bounded: worst={worst}"
+    finally:
+        burn_mod.Cluster = orig
 
 
 def test_burn_deterministic_with_durability():
