@@ -334,19 +334,18 @@ class CommandStore:
                 r.start, r.end, lambda acc, f: acc or ts < f, hit)
         return hit
 
-    def _below_floor(self, cmd, floor_map: ReducingRangeMap) -> bool:
+    def _below_floor(self, cmd, floor_map: ReducingRangeMap, owned) -> bool:
         """Is every owned key/range of `cmd` covered by a floor segment above
-        its id? (A command with no definition -- a blind invalidation --
-        requires the WHOLE owned slice floored, else such records accumulate
+        its id? `owned` is the precomputed owned slice of the command's keys
+        (None for a blind invalidation with no definition -- droppable only
+        once the WHOLE owned slice is floored, else such records accumulate
         forever under chaos.)"""
         from accord_tpu.local.status import Status as _S
         ts = cmd.txn_id.as_timestamp()
-        keys = cmd.txn.keys if cmd.txn is not None else None
-        if keys is None:
+        if owned is None:
             return cmd.is_(_S.INVALIDATED) and all(
                 floor_map.covers(r.start, r.end, lambda f: ts < f)
                 for r in self.ranges)
-        owned = self.owned(keys)
         if isinstance(owned, Keys):
             return len(owned) > 0 and all(
                 (f := floor_map.get(k)) is not None and ts < f
@@ -389,10 +388,12 @@ class CommandStore:
                 continue
             if cmd.waiters:
                 continue  # someone still watches it; let them resolve first
-            if not erase_floor.is_empty() and self._below_floor(cmd, erase_floor):
+            owned = self.owned(cmd.txn.keys) if cmd.txn is not None else None
+            if not erase_floor.is_empty() \
+                    and self._below_floor(cmd, erase_floor, owned):
                 erased.append(txn_id)
             elif not cmd.cleaned and not shrink_floor.is_empty() \
-                    and self._below_floor(cmd, shrink_floor):
+                    and self._below_floor(cmd, shrink_floor, owned):
                 self._shrink(cmd)
         for txn_id in erased:
             cmd = self.commands.pop(txn_id)
@@ -535,11 +536,10 @@ class CommandStore:
             return None
         # every point of every owned range must be floored; take the min
         for r in _as_ranges(owned):
-            if not floor_map.covers(r.start, r.end, lambda f: True):
+            f = _min_floor_over_range(floor_map, r.start, r.end)
+            if f is None:
                 return None
-            out = floor_map.fold_over_range(
-                r.start, r.end,
-                lambda acc, f: f if acc is None or f < acc else acc, out)
+            out = f if out is None or f < out else out
         return out
 
     def is_rejected_if_not_preaccepted(self, txn_id: TxnId,
@@ -584,53 +584,67 @@ class CommandStore:
         if rb.is_empty():
             return deps
         owned = self.owned(seekables)
-        kb = KeyDepsBuilder()
-        rbld = RangeDepsBuilder()
         if isinstance(owned, Keys):
-            floors = {}
-            for k in owned:
-                f = rb.get(k)
-                if f is not None:
-                    floors[k] = f
+            floors = [(k, f) for k in owned if (f := rb.get(k)) is not None]
             if not floors:
                 return deps
-            for k, ids in deps.key_deps.items():
-                f = floors.get(k)
+            edges = KeyDepsBuilder()
+            for k, f in floors:
+                fid = TxnId.from_timestamp(f)
+                if fid != txn_id:
+                    edges.add(k, fid)
+            kd = deps.key_deps
+            # fast path (the steady state): no row holds an id below its
+            # floor -- rows are sorted, so checking each row's FIRST id
+            # suffices; the result is then a pure linear union with the edges
+            if not any(self._row_has_id_below(kd, k, f) for k, f in floors):
+                return Deps(kd.union(edges.build()), deps.range_deps)
+            kb = KeyDepsBuilder()
+            fmap = dict(floors)
+            for k, ids in kd.items():
+                f = fmap.get(k)
                 if f is None:
                     kb.add_all(k, ids)
                 else:
                     kb.add_all(k, [t for t in ids if not t < f])
-            for k, f in floors.items():
+            for k, f in floors:
                 fid = TxnId.from_timestamp(f)
                 if fid != txn_id:
                     kb.add(k, fid)
             # key subjects carry no range rows of their own; pass them through
-            for r, ids in deps.range_deps.items():
-                rbld.add_all(r, ids)
-        else:
-            for r, ids in deps.range_deps.items():
-                fmin = None
-                if rb.covers(r.start, r.end, lambda v: True):
-                    fmin = rb.fold_over_range(
-                        r.start, r.end,
-                        lambda acc, v: v if acc is None or v < acc else acc,
-                        None)
-                kept = ids if fmin is None else [t for t in ids if not t < fmin]
-                if kept:
-                    rbld.add_all(r, kept)
-            for rr in _as_ranges(owned):
-                for s, e, f in rb.segments():
-                    lo, hi = max(s, rr.start), min(e, rr.end)
-                    if lo < hi and f is not None:
-                        fid = TxnId.from_timestamp(f)
-                        if fid != txn_id:
-                            rbld.add(Range(lo, hi), fid)
-            for k, ids in deps.key_deps.items():
-                f = rb.get(k)
-                kept = ids if f is None else [t for t in ids if not t < f]
-                if kept:
-                    kb.add_all(k, kept)
+            return Deps(kb.build(), deps.range_deps)
+        # range subjects (sync points): once per durability round, not hot
+        kb = KeyDepsBuilder()
+        rbld = RangeDepsBuilder()
+        for r, ids in deps.range_deps.items():
+            fmin = _min_floor_over_range(rb, r.start, r.end)
+            kept = ids if fmin is None else [t for t in ids if not t < fmin]
+            if kept:
+                rbld.add_all(r, kept)
+        for rr in _as_ranges(owned):
+            for s, e, f in rb.segments():
+                lo, hi = max(s, rr.start), min(e, rr.end)
+                if lo < hi and f is not None:
+                    fid = TxnId.from_timestamp(f)
+                    if fid != txn_id:
+                        rbld.add(Range(lo, hi), fid)
+        for k, ids in deps.key_deps.items():
+            f = rb.get(k)
+            kept = ids if f is None else [t for t in ids if not t < f]
+            if kept:
+                kb.add_all(k, kept)
         return Deps(kb.build(), rbld.build())
+
+    @staticmethod
+    def _row_has_id_below(kd, key, floor) -> bool:
+        from bisect import bisect_left
+        i = bisect_left(kd.keys, key)
+        if i >= len(kd.keys) or kd.keys[i] != key:
+            return False
+        lo, hi = kd.offsets[i], kd.offsets[i + 1]
+        # value_idx rows are sorted dictionary indices and the dictionary is
+        # sorted by id, so the row's first entry is its minimum id
+        return hi > lo and kd.txn_ids[kd.value_idx[lo]] < floor
 
     def calculate_deps_async(self, txn_id: TxnId, seekables: Seekables,
                              before: Timestamp) -> AsyncResult:
@@ -889,6 +903,15 @@ class CommandStore:
 
 def _as_ranges(seekables: Seekables) -> Ranges:
     return seekables if isinstance(seekables, Ranges) else seekables.to_ranges()
+
+
+def _min_floor_over_range(floor_map: ReducingRangeMap, start, end):
+    """Min floor value over [start, end) when the map FULLY covers it, else
+    None (a gap means some point has no floor, so nothing may be elided)."""
+    if not floor_map.covers(start, end, lambda v: True):
+        return None
+    return floor_map.fold_over_range(
+        start, end, lambda acc, v: v if acc is None or v < acc else acc, None)
 
 
 class _NoopProgressLog:

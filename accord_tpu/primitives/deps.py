@@ -90,12 +90,9 @@ class KeyDeps:
             return other
         if other.is_empty():
             return self
-        b = KeyDepsBuilder()
-        for k, ids in self.items():
-            b.add_all(k, ids)
-        for k, ids in other.items():
-            b.add_all(k, ids)
-        return b.build()
+        return KeyDeps(*_csr_union(
+            self.keys, self.txn_ids, self.offsets, self.value_idx,
+            other.keys, other.txn_ids, other.offsets, other.value_idx))
 
     def slice(self, ranges: Ranges) -> "KeyDeps":
         if self.is_empty() or ranges.is_empty():
@@ -168,6 +165,62 @@ class KeyDepsBuilder:
 KeyDeps.EMPTY = KeyDeps((), (), (0,), ())
 
 
+def _csr_union(a_keys, a_ids, a_off, a_vidx, b_keys, b_ids, b_off, b_vidx):
+    """Linear union of two CSR multimaps (the reference's
+    RelationMultiMap.linearUnion): single sorted sweeps, no per-element
+    hashing. Works for KeyDeps and RangeDeps alike (rows sorted by key/range,
+    ids sorted within the dictionary and within each row)."""
+    # 1. merged dictionary + monotone index remaps for both sides
+    ids: List = []
+    remap_a = [0] * len(a_ids)
+    remap_b = [0] * len(b_ids)
+    i = j = 0
+    while i < len(a_ids) or j < len(b_ids):
+        if j >= len(b_ids) or (i < len(a_ids) and a_ids[i] <= b_ids[j]):
+            if j < len(b_ids) and a_ids[i] == b_ids[j]:
+                remap_b[j] = len(ids)
+                j += 1
+            remap_a[i] = len(ids)
+            ids.append(a_ids[i])
+            i += 1
+        else:
+            remap_b[j] = len(ids)
+            ids.append(b_ids[j])
+            j += 1
+    # 2. merge rows in key order; remapped rows stay sorted (remaps monotone)
+    keys: List = []
+    offsets = [0]
+    value_idx: List[int] = []
+    i = j = 0
+    while i < len(a_keys) or j < len(b_keys):
+        if j >= len(b_keys) or (i < len(a_keys) and a_keys[i] < b_keys[j]):
+            keys.append(a_keys[i])
+            value_idx.extend(remap_a[v] for v in a_vidx[a_off[i]:a_off[i + 1]])
+            i += 1
+        elif i >= len(a_keys) or b_keys[j] < a_keys[i]:
+            keys.append(b_keys[j])
+            value_idx.extend(remap_b[v] for v in b_vidx[b_off[j]:b_off[j + 1]])
+            j += 1
+        else:  # same key: sorted-merge the two rows, deduplicating
+            keys.append(a_keys[i])
+            ra = [remap_a[v] for v in a_vidx[a_off[i]:a_off[i + 1]]]
+            rb = [remap_b[v] for v in b_vidx[b_off[j]:b_off[j + 1]]]
+            p = q = 0
+            while p < len(ra) or q < len(rb):
+                if q >= len(rb) or (p < len(ra) and ra[p] <= rb[q]):
+                    if q < len(rb) and ra[p] == rb[q]:
+                        q += 1
+                    value_idx.append(ra[p])
+                    p += 1
+                else:
+                    value_idx.append(rb[q])
+                    q += 1
+            i += 1
+            j += 1
+        offsets.append(len(value_idx))
+    return tuple(keys), tuple(ids), tuple(offsets), tuple(value_idx)
+
+
 class RangeDeps:
     """range -> sorted set of TxnId. Linear-scan interval queries for now; the
     reference accelerates this with a checkpointed interval index
@@ -227,12 +280,9 @@ class RangeDeps:
             return other
         if other.is_empty():
             return self
-        b = RangeDepsBuilder()
-        for r, ids in self.items():
-            b.add_all(r, ids)
-        for r, ids in other.items():
-            b.add_all(r, ids)
-        return b.build()
+        return RangeDeps(*_csr_union(
+            self.ranges, self.txn_ids, self.offsets, self.value_idx,
+            other.ranges, other.txn_ids, other.offsets, other.value_idx))
 
     def slice(self, window: Ranges) -> "RangeDeps":
         if self.is_empty() or window.is_empty():
