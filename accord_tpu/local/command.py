@@ -79,9 +79,11 @@ class Command:
         # (bootstrapped_at map identity, floor) memo for dep elision
         self.elision_floor_cache = None
         # tier-A truncation (reference: Cleanup.TRUNCATE_WITH_OUTCOME): the
-        # conflict-registry entries and deps were dropped, but the outcome
-        # (txn/executeAt/writes/result) is retained so straggler replicas can
-        # still repair from us until the outcome is universally durable
+        # conflict-registry entries (cfk rows, device lanes) were dropped,
+        # but the outcome AND deps (txn/executeAt/deps/writes/result) are
+        # retained so straggler replicas can still repair from us -- and
+        # order the replayed applies -- until the outcome is universally
+        # durable
         self.cleaned = False
 
     # -- knowledge predicates (the reference's Known vector) ----------------

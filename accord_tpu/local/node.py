@@ -39,6 +39,7 @@ class Node:
                  data_store, num_stores: int = 1,
                  progress_log_factory: Optional[Callable] = None,
                  deps_resolver=None, deps_batch_window_ms: Optional[float] = 0.0,
+                 device_latency_ms: float = 4.0,
                  events: Optional[EventsListener] = None):
         self.id = node_id
         self.message_sink = message_sink
@@ -56,6 +57,10 @@ class Node:
         # micro-batch coalescing window for the device deps path (None =
         # inline, no deferral; see CommandStore.submit_preaccept)
         self.deps_batch_window_ms = deps_batch_window_ms
+        # simulated dispatch->harvest delay of the async device pipeline:
+        # models real accelerator latency AND gives the pipeline depth that
+        # hides the host<->device round trip (see ops/resolver.py)
+        self.device_latency_ms = device_latency_ms
         self.command_stores: Optional[CommandStores] = None
         # HLC state (reference: Node.uniqueNow CAS loop, local/Node.java:348)
         self._last_hlc = 0

@@ -60,13 +60,8 @@ class CommandStore:
         self.progress_log = (progress_log_factory(self) if progress_log_factory
                              else _NoopProgressLog())
         self.deps_resolver = deps_resolver  # None -> host scan below
-        # micro-batch tick state (SURVEY section-7 host<->device engineering):
-        # PreAccepts queue here and drain through ONE batched max-conflict +
-        # ONE batched deps kernel call per tick
-        self._preaccept_queue: list = []
-        self._deps_queue: list = []
-        self._tick_scheduled = False
-        self._mc_override: Optional[Dict[TxnId, Optional[Timestamp]]] = None
+        # micro-batch coalescing window for the async device path (resolver
+        # owns the per-NODE tick; see ops/resolver.BatchDepsResolver):
         # 0.0 = coalesce same-scheduler-turn arrivals; None = inline (no
         # deferral -- bit-identical timing with the host path, used by the
         # differential tests)
@@ -229,13 +224,8 @@ class CommandStore:
                                seekables: Seekables) -> Optional[Timestamp]:
         """Max-conflict via the device kernel when a resolver is installed
         (merged with the host range map, which tracks range-domain txns);
-        host scan otherwise. During a batch tick the per-subject result was
-        precomputed by ONE batched kernel call and is injected here."""
-        if self._mc_override is not None and txn_id in self._mc_override:
-            handled, ts = self._mc_override[txn_id]
-            if handled:
-                return self._merge_range_map_conflicts(ts, seekables)
-            return self.max_conflict_ts(seekables)  # collision: host decides
+        host scan otherwise. In batched mode the resolver declines (the O(1)
+        incremental host map is faster than a synchronous device round trip)."""
         if self.deps_resolver is not None:
             handled, device_max = self.deps_resolver.max_conflict(
                 self, txn_id, seekables)
@@ -648,45 +638,33 @@ class CommandStore:
 
     def calculate_deps_async(self, txn_id: TxnId, seekables: Seekables,
                              before: Timestamp) -> AsyncResult:
-        """calculate_deps, micro-batched through the per-store tick alongside
-        queued PreAccepts (the Accept round's deps query is as hot as
-        PreAccept's under contention -- the slow path runs both)."""
+        """calculate_deps, micro-batched through the resolver's per-node tick
+        alongside queued PreAccepts (the Accept round's deps query is as hot
+        as PreAccept's under contention -- the slow path runs both)."""
         resolver = self.deps_resolver
-        if resolver is None or not hasattr(resolver, "resolve_batch") \
+        if resolver is None or not hasattr(resolver, "enqueue_deps") \
                 or not isinstance(seekables, Keys) \
                 or self.batch_window_ms is None:
             return success(self.calculate_deps(txn_id, seekables, before))
-        out = AsyncResult()
-        self._deps_queue.append((txn_id, seekables, before, out))
-        self._schedule_tick()
-        return out
+        return resolver.enqueue_deps(self, txn_id, seekables, before)
 
     # -- the micro-batched PreAccept path ------------------------------------
     def submit_preaccept(self, txn_id: TxnId, partial_txn, route,
                          ballot=None) -> AsyncResult:
         """PreAccept against this store. With a batch resolver installed,
-        subjects queue and drain through a per-store tick: ONE batched
-        max-conflict kernel call decides every witnessed timestamp, then ONE
-        batched deps kernel call computes every deps set (SURVEY section 7:
-        amortizing the host<->device round trip over the micro-batch).
+        subjects queue on the resolver's per-NODE tick: every store's queued
+        work drains through ONE asynchronously-dispatched deps kernel call
+        (see ops/resolver.BatchDepsResolver for the pipeline design).
         Completes with (outcome, witnessed_at, deps)."""
-        from accord_tpu.local import commands
         from accord_tpu.primitives.timestamp import Ballot
         ballot = ballot or Ballot.ZERO
         resolver = self.deps_resolver
-        if resolver is None or not hasattr(resolver, "max_conflict_batch") \
+        if resolver is None or not hasattr(resolver, "enqueue_preaccept") \
                 or not isinstance(partial_txn.keys, Keys) \
                 or self.batch_window_ms is None:
             return success(self._preaccept_now(txn_id, partial_txn, route, ballot))
-        out = AsyncResult()
-        self._preaccept_queue.append((txn_id, partial_txn, route, ballot, out))
-        self._schedule_tick()
-        return out
-
-    def _schedule_tick(self) -> None:
-        if not self._tick_scheduled:
-            self._tick_scheduled = True
-            self.node.scheduler.once(self.batch_window_ms, self._preaccept_tick)
+        return resolver.enqueue_preaccept(self, txn_id, partial_txn, route,
+                                          ballot)
 
     def _preaccept_now(self, txn_id, partial_txn, route, ballot):
         from accord_tpu.local import commands
@@ -697,82 +675,6 @@ class CommandStore:
         witnessed = self.command(txn_id).execute_at
         deps = self.calculate_deps(txn_id, self.owned(partial_txn.keys), witnessed)
         return (outcome, witnessed, deps)
-
-    def _preaccept_tick(self) -> None:
-        from accord_tpu.local import commands
-        from accord_tpu.local.commands import AcceptOutcome
-        self._tick_scheduled = False
-        batch, self._preaccept_queue = self._preaccept_queue, []
-        deps_batch, self._deps_queue = self._deps_queue, []
-        if not batch:
-            if deps_batch:
-                self._drain_deps_queue(deps_batch)
-            return
-        # phase 1: one batched max-conflict for every queued subject
-        # (handled=False = bucket collision: the host scan decides, recorded
-        # so _max_conflict_resolved skips a redundant 1-subject device call)
-        mc = self.deps_resolver.max_conflict_batch(
-            self, [(t, self.owned(p.keys)) for t, p, _, _, _ in batch])
-        self._mc_override = {t: res for (t, p, _, _, _), res in zip(batch, mc)}
-        phase1 = []
-        try:
-            # phase 2: host preaccept logic per subject, injected max-conflict;
-            # registrations append to the device active set incrementally, so
-            # batchmates witness each other in phase 3 (valid: deps may be any
-            # conservative superset; execution still orders by executeAt)
-            for (t, p, route, ballot, out) in batch:
-                try:
-                    outcome = commands.preaccept(self, t, p, route, ballot)
-                except BaseException as e:  # noqa: BLE001
-                    # never strand the batchmates: fail THIS subject's reply
-                    # like the inline path would, keep draining the rest
-                    out.try_set_failure(e)
-                    phase1.append((t, p, None, None, None))
-                    continue
-                if outcome in (AcceptOutcome.REJECTED_BALLOT,
-                               AcceptOutcome.TRUNCATED):
-                    phase1.append((t, p, outcome, None, out))
-                else:
-                    phase1.append((t, p, outcome,
-                                   self.command(t).execute_at, out))
-        finally:
-            self._mc_override = None
-        # phase 3: ONE batched deps resolve for the accepted subjects plus
-        # any queued standalone deps queries (Accept-round / GetDeps)
-        subjects = [(t, self.owned(p.keys), w)
-                    for (t, p, oc, w, _) in phase1 if w is not None]
-        extra = [(t, self.owned(ks), before)
-                 for (t, ks, before, _) in deps_batch]
-        rows = self.deps_resolver.resolve_batch(self, subjects + extra) \
-            if subjects or extra else []
-        need_host_ranges = bool(self.range_txns)
-        it = iter(rows)
-        for (t, p, oc, w, out) in phase1:
-            if out is None:
-                continue  # failed in phase 2; reply already failed
-            if w is None:
-                out.try_set_success((oc, None, None))
-                continue
-            deps = next(it)
-            if need_host_ranges:
-                deps = deps.union(self.host_range_deps(
-                    t, self.owned(p.keys), w))
-            out.try_set_success((oc, w, self.inject_dep_floor(t, p.keys, deps)))
-        for (t, ks, before, out) in deps_batch:
-            deps = next(it)
-            if need_host_ranges:
-                deps = deps.union(self.host_range_deps(t, self.owned(ks), before))
-            out.try_set_success(self.inject_dep_floor(t, ks, deps))
-
-    def _drain_deps_queue(self, deps_batch) -> None:
-        subjects = [(t, self.owned(ks), before)
-                    for (t, ks, before, _) in deps_batch]
-        rows = self.deps_resolver.resolve_batch(self, subjects)
-        need_host_ranges = bool(self.range_txns)
-        for (t, ks, before, out), deps in zip(deps_batch, rows):
-            if need_host_ranges:
-                deps = deps.union(self.host_range_deps(t, self.owned(ks), before))
-            out.try_set_success(self.inject_dep_floor(t, ks, deps))
 
     def host_range_deps(self, txn_id: TxnId, seekables: Seekables,
                         before: Timestamp) -> Deps:

@@ -7,22 +7,31 @@ query is an SPI:
 
   HostDepsResolver  -- delegates to the store's Python scan (reference
                        behaviour, used for differential testing)
-  BatchDepsResolver -- maintains an INCREMENTAL device mirror of each store's
-                       active set (append-only rows + status-lane updates fed
-                       by the store's register() funnel) and answers deps /
-                       max-conflict queries with batched MXU kernels; exact
-                       per-key CSR is recovered on host by intersecting real
-                       key sets (bucket collisions are filtered, so the result
-                       equals the host scan).
+  BatchDepsResolver -- maintains an incremental DEVICE ARENA per node (all of
+                       the node's stores share it) and answers deps queries
+                       with one fused MXU kernel per node tick, fully
+                       asynchronously.
 
-Device-state maintenance (the SURVEY section-7 latency engineering):
-  - every store.register() appends a row or updates a row's lanes host-side
-    and marks it dirty; nothing is re-encoded wholesale (the round-1 design
-    re-encoded the full active set per PreAccept: O(n^2) cumulative);
-  - rows are pushed to the device lazily, right before a kernel call, as a
-    single scatter of the dirty rows (padded to power-of-two buckets so jit
-    caches stay warm);
-  - capacity doubles by re-pushing whole arrays (rare, amortized).
+Why the shape of this design (measured on the target TPU-via-tunnel setup):
+  - kernel enqueue is ~17 us but ANY synchronous device->host readback costs
+    a full tunnel round trip (~110 ms), while ASYNC copies pipeline almost
+    perfectly (~5-8 ms marginal per in-flight call);
+  - the host->device link is slow (~5 MB/s), so the arena is maintained by
+    scattering KEY INDICES (i32[n, MAXK]) and rebuilding bitmap rows on
+    device, and results come back BIT-PACKED (u32[B, cap/32], 8x smaller
+    than a boolean matrix and independent of how many deps each subject
+    has).
+
+Async protocol (deterministic): a node tick drains every store's queued
+PreAccepts/deps queries, runs the host-side preaccept transitions (witness
+timestamps come from the O(1) host MaxConflicts map), dispatches ONE kernel
+call for the whole batch (enqueue + copy_to_host_async -- no blocking), and
+schedules a HARVEST event `device_latency_ms` of *simulated* time later. The
+harvest consumes the transfer (blocking real time only if the pipeline is
+shallower than the tunnel latency), recovers exact per-key deps by
+intersecting real key sets (bucket collisions filtered), and completes the
+replies. Because dispatch and harvest points are pure functions of simulated
+state, runs remain bit-for-bit deterministic.
 """
 from __future__ import annotations
 
@@ -31,12 +40,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from accord_tpu.local.cfk import CfkStatus
-from accord_tpu.ops.encoding import (
-    TimestampEncoder, WITNESS_TABLE, encode_key_bitmaps,
-)
+from accord_tpu.ops.encoding import TimestampEncoder, WITNESS_TABLE
 from accord_tpu.primitives.deps import Deps, KeyDepsBuilder
 from accord_tpu.primitives.keyspace import Keys, Seekables
 from accord_tpu.primitives.timestamp import Timestamp, TxnId
+from accord_tpu.utils.async_ import AsyncResult, success
 from accord_tpu.utils.invariants import Invariants
 
 
@@ -64,192 +72,516 @@ class HostDepsResolver(DepsResolver):
         return store.host_calculate_deps(txn_id, seekables, before)
 
 
-class _StoreDeviceState:
-    """Incremental device mirror of one store's key-domain active set.
+def warmup(num_buckets: int = 1024, cap: int = 8192,
+           batch_tiers=(8, 64), scatter_tiers=(8, 64)) -> None:
+    """Pre-compile the jit shape tiers the async pipeline uses (first
+    compilation costs seconds on a tunnelled TPU; production would do the
+    same at process start). The jit cache is process-global, so one call
+    covers every resolver with the same (num_buckets, cap)."""
+    import jax.numpy as jnp
+    from accord_tpu.ops.kernels import arena_scatter, deps_resolve
+    neg = np.iinfo(np.int32).min
+    bm = jnp.zeros((cap, num_buckets), jnp.float32)
+    ts = jnp.zeros((cap, 3), jnp.int32)
+    ex = jnp.full((cap, 3), neg, jnp.int32)
+    kd = jnp.zeros(cap, jnp.int32)
+    vl = jnp.zeros(cap, bool)
+    table = jnp.asarray(WITNESS_TABLE)
+    out = None
+    for m in scatter_tiers:
+        out = arena_scatter(
+            bm, ts, ex, kd, vl, jnp.zeros(m, jnp.int32),
+            jnp.full((m, _NodeArena.MAXK), -1, jnp.int32),
+            jnp.zeros((m, 3), jnp.int32), jnp.zeros((m, 3), jnp.int32),
+            jnp.zeros(m, jnp.int32), jnp.zeros(m, bool))
+    for b in batch_tiers:
+        out = deps_resolve(
+            jnp.full((b, _NodeArena.MAXK), -1, jnp.int32),
+            jnp.zeros((b, 3), jnp.int32), jnp.zeros(b, jnp.int32),
+            bm, ts, kd, vl, table)
+    if out is not None:
+        import jax
+        jax.block_until_ready(out)
 
-    Host-side numpy arrays of capacity `cap` plus a device copy that is
-    synchronized by scattering dirty rows (or re-pushed wholesale after a
-    capacity growth). Rows are append-only; status changes touch lanes:
-      valid    -- False once INVALIDATED (drops the row from deps scans)
-      exec_ts  -- monotone max of registered conflict timestamps (feeds the
-                  max-conflict kernel)
+
+class _NodeArena:
+    """Incremental device mirror of one NODE's key-domain active set (rows
+    keyed by txn id; a txn registering in several of the node's stores
+    accumulates the union of its owned keys in one row -- exact per-key
+    recovery at harvest filters cross-store/bucket false positives).
+
+    Device arrays (authoritative once scattered): bitmaps f32[cap, K],
+    ts i32[cap, 3], exec_ts i32[cap, 3], kinds i32[cap], valid bool[cap].
+    Host shadows exist only to source dirty-row scatters and exact key sets.
     """
 
+    MAXK = 16   # key indices per scatter row; wider rows go host_only
     GROW = 2
 
-    def __init__(self, num_buckets: int, initial_cap: int = 256):
+    def __init__(self, num_buckets: int, initial_cap: int = 4096):
         self.num_buckets = num_buckets
         self.cap = initial_cap
         self.count = 0
         self.txn_ids: List[TxnId] = []
-        self.key_sets: List[tuple] = []
+        self.key_sets: List[frozenset] = []
         self.row_of: Dict[TxnId, int] = {}
         self.encoder: Optional[TimestampEncoder] = None
-        self.bitmaps = np.zeros((self.cap, num_buckets), dtype=np.float32)
+        self.exec_max: List[Optional[Timestamp]] = []
+        # host shadows for scatter sourcing
         self.ts = np.zeros((self.cap, 3), dtype=np.int32)
         self.exec_ts = np.full((self.cap, 3), np.iinfo(np.int32).min,
                                dtype=np.int32)
         self.kinds = np.zeros(self.cap, dtype=np.int32)
         self.valid = np.zeros(self.cap, dtype=bool)
-        self.exec_max: List[Optional[Timestamp]] = []
+        self.keys_mod = np.full((self.cap, self.MAXK), -1, dtype=np.int32)
+        # per-KEY packed row bitmask (u32[cap/32]): which arena rows touch
+        # the key. AND-ing it with a subject's packed dependency row yields
+        # that key's dependency rows with pure numpy -- the vectorized CSR
+        # decode that makes the device path cheaper than the host scan
+        self.key_rows: Dict[object, np.ndarray] = {}
+        # rows whose key set exceeds MAXK: excluded from the device (valid
+        # False) and scanned host-side at harvest (rare)
+        self.host_only: set = set()
+        # rows of INVALIDATED txns: the device excludes them via the valid
+        # lane; the host_only scan must exclude them too (the `valid` lane is
+        # overloaded -- it is also false for host_only/emptied rows)
+        self.invalidated: set = set()
+        # once any truncation shrank a row, the device bitmap may understate
+        # historical key coverage -- the (monotone) max-conflict kernel must
+        # defer to the host map from then on
+        self.had_truncation = False
         self._dirty_rows: set = set()
-        self._device = None          # tuple of jnp arrays or None
-        self._device_count = 0       # rows valid on device
+        self._device = None
 
     # -- host-side mutation ---------------------------------------------------
     def _ensure_encoder(self, ts: Timestamp) -> None:
         if self.encoder is None:
             # base epoch 0: epochs are small ints, and the epoch delta must
             # stay non-negative even when an OLDER-epoch txn registers after
-            # a newer one (ExtraEpochs re-contacts send old-epoch txn ids to
-            # new-epoch replicas); the hlc window is symmetric around the
-            # first-seen hlc
+            # a newer one; the hlc window is symmetric around the first hlc
             self.encoder = TimestampEncoder(0, ts.hlc)
 
-    def _grow(self) -> None:
+    def _grow_host(self) -> None:
         new_cap = self.cap * self.GROW
-        for name in ("bitmaps", "ts", "exec_ts", "kinds", "valid"):
-            a = getattr(self, name)
-            pad = [(0, new_cap - self.cap)] + [(0, 0)] * (a.ndim - 1)
-            setattr(self, name, np.pad(
-                a, pad, constant_values=(np.iinfo(np.int32).min
-                                         if name == "exec_ts" else 0)))
+        self.ts = np.pad(self.ts, ((0, new_cap - self.cap), (0, 0)))
+        self.exec_ts = np.pad(self.exec_ts, ((0, new_cap - self.cap), (0, 0)),
+                              constant_values=np.iinfo(np.int32).min)
+        self.kinds = np.pad(self.kinds, (0, new_cap - self.cap))
+        self.valid = np.pad(self.valid, (0, new_cap - self.cap))
+        self.keys_mod = np.pad(self.keys_mod,
+                               ((0, new_cap - self.cap), (0, 0)),
+                               constant_values=-1)
+        for k in self.key_rows:
+            self.key_rows[k] = np.pad(self.key_rows[k],
+                                      (0, (new_cap - self.cap) // 32))
         self.cap = new_cap
-        self._device = None  # full re-push
 
-    def append(self, txn_id: TxnId, key_set: tuple,
-               conflict_ts: Timestamp) -> int:
-        self._ensure_encoder(txn_id)
-        Invariants.check_state(self.encoder.in_window(txn_id),
-                               "active txn %s outside encoder window", txn_id)
-        if self.count == self.cap:
-            self._grow()
-        row = self.count
-        self.count += 1
-        self.txn_ids.append(txn_id)
-        self.key_sets.append(key_set)
-        self.exec_max.append(None)
-        self.row_of[txn_id] = row
-        bm = self.bitmaps[row]
-        for k in key_set:
-            bm[int(k) % self.num_buckets] = 1.0
-        self.ts[row] = self.encoder.encode([txn_id])[0]
-        self.kinds[row] = int(txn_id.kind)
-        self.valid[row] = True
-        self._bump_exec(row, conflict_ts)
-        self._dirty_rows.add(row)
-        return row
-
-    def _bump_exec(self, row: int, conflict_ts: Timestamp) -> None:
+    def update(self, txn_id: TxnId, key_set, status: CfkStatus,
+               conflict_ts: Timestamp) -> None:
+        key_set = frozenset(key_set)
+        row = self.row_of.get(txn_id)
+        if row is None:
+            self._ensure_encoder(txn_id)
+            Invariants.check_state(self.encoder.in_window(txn_id),
+                                   "active txn %s outside encoder window",
+                                   txn_id)
+            if self.count == self.cap:
+                self._grow_host()
+                if self._device is not None:
+                    from accord_tpu.ops.kernels import arena_grow
+                    self._device = arena_grow(*self._device, new_cap=self.cap)
+            row = self.count
+            self.count += 1
+            self.txn_ids.append(txn_id)
+            self.key_sets.append(frozenset(key_set))
+            self.exec_max.append(None)
+            self.row_of[txn_id] = row
+            self.ts[row] = self.encoder.encode([txn_id])[0]
+            self.kinds[row] = int(txn_id.kind)
+            self.valid[row] = True
+            self._set_row_keys(row)
+            for k in key_set:
+                self._set_key_row_bit(k, row)
+        elif key_set and not (key_set <= self.key_sets[row]):
+            # a later registration may widen the key set (partial txn unions)
+            # -- including invalidations, whose keys must stay visible to the
+            # monotone max-conflict kernel
+            for k in key_set - self.key_sets[row]:
+                self._set_key_row_bit(k, row)
+            self.key_sets[row] = self.key_sets[row] | frozenset(key_set)
+            self._set_row_keys(row)
+        # MaxConflicts is monotone in the reference: even an invalidated
+        # txn's registration bumps the conflict floor
         prev = self.exec_max[row]
         if prev is None or conflict_ts > prev:
             self.exec_max[row] = conflict_ts
             self.exec_ts[row] = self.encoder.encode([conflict_ts])[0]
-
-    def update(self, txn_id: TxnId, key_set: tuple, status: CfkStatus,
-               conflict_ts: Timestamp) -> None:
-        row = self.row_of.get(txn_id)
-        if row is None:
-            row = self.append(txn_id, key_set, conflict_ts)
-        else:
-            # a later registration may widen the key set (partial txn
-            # unions) -- including invalidations, whose keys must stay
-            # visible to the (monotone) max-conflict kernel
-            if key_set and any(k not in self.key_sets[row] for k in key_set):
-                merged = tuple(sorted(set(self.key_sets[row]) | set(key_set)))
-                self.key_sets[row] = merged
-                bm = self.bitmaps[row]
-                for k in merged:
-                    bm[int(k) % self.num_buckets] = 1.0
-            # MaxConflicts is monotone in the reference: even an invalidated
-            # txn's registration bumps the conflict floor
-            self._bump_exec(row, conflict_ts)
         if status == CfkStatus.INVALIDATED:
             # drops the row from deps scans (a dep that never applies);
             # never reset -- invalidation is terminal
             self.valid[row] = False
+            self.invalidated.add(row)
+        self._dirty_rows.add(row)
+
+    def _set_row_keys(self, row: int) -> None:
+        ks = self.key_sets[row]
+        if len(ks) > self.MAXK:
+            self.host_only.add(row)
+            self.valid[row] = False
+            return
+        mods = sorted({int(k) % self.num_buckets for k in ks})
+        self.keys_mod[row] = -1
+        self.keys_mod[row, :len(mods)] = mods
+
+    def _set_key_row_bit(self, key, row: int) -> None:
+        kr = self.key_rows.get(key)
+        if kr is None:
+            kr = self.key_rows[key] = np.zeros(self.cap // 32, np.uint32)
+        kr[row >> 5] |= np.uint32(1 << (row & 31))
+
+    def _clear_key_row_bit(self, key, row: int) -> None:
+        kr = self.key_rows.get(key)
+        if kr is not None:
+            kr[row >> 5] &= np.uint32(~(1 << (row & 31)) & 0xFFFFFFFF)
+
+    def decode_packed(self, txn_id: TxnId, owned_keys, prow: np.ndarray):
+        """Vectorized CSR recovery: AND the subject's packed dependency row
+        with each key's packed row bitmask, then assemble the KeyDeps arrays
+        with numpy (unique/lexsort/fancy-index) -- no per-dependency Python.
+        Exactness: key_rows bits track REAL key sets, so bucket collisions
+        and cross-store rows drop out here; invalid rows were already
+        excluded by the kernel's valid lane."""
+        from accord_tpu.primitives.deps import KeyDeps
+        srow = self.row_of.get(txn_id)
+        if srow is not None and (prow[srow >> 5] >> np.uint32(srow & 31)) & 1:
+            prow = prow.copy()
+            prow[srow >> 5] &= np.uint32(~(1 << (srow & 31)) & 0xFFFFFFFF)
+        keys = []
+        per_key_rows = []
+        for k in owned_keys:
+            kr = self.key_rows.get(k)
+            if kr is None:
+                continue
+            mask = prow & kr[:len(prow)]
+            if not mask.any():
+                continue
+            rows = np.nonzero(
+                np.unpackbits(mask.view(np.uint8), bitorder="little"))[0]
+            keys.append(k)
+            per_key_rows.append(rows)
+        if not keys:
+            return KeyDeps.EMPTY
+        uniq = np.unique(np.concatenate(per_key_rows))
+        ts = self.ts
+        order = np.lexsort((ts[uniq, 2], ts[uniq, 1], ts[uniq, 0]))
+        sorted_rows = uniq[order]
+        inv = np.empty(int(uniq[-1]) + 1, np.int32)
+        inv[sorted_rows] = np.arange(len(sorted_rows), dtype=np.int32)
+        txn_ids = tuple(self.txn_ids[int(j)] for j in sorted_rows)
+        offsets = [0]
+        value_idx: List[int] = []
+        for rows in per_key_rows:
+            value_idx.extend(np.sort(inv[rows]).tolist())
+            offsets.append(len(value_idx))
+        return KeyDeps(tuple(keys), txn_ids, tuple(offsets), tuple(value_idx))
+
+    def remove_keys(self, txn_id: TxnId, keys) -> None:
+        """A store truncated its record of txn_id: its slice of the keys no
+        longer yields deps (other stores' keys in the row live on)."""
+        row = self.row_of.get(txn_id)
+        if row is None:
+            return
+        remaining = self.key_sets[row] - frozenset(keys)
+        if remaining == self.key_sets[row]:
+            return
+        for k in self.key_sets[row] - remaining:
+            self._clear_key_row_bit(k, row)
+        self.key_sets[row] = remaining
+        self.had_truncation = True
+        if not remaining:
+            self.valid[row] = False
+            self.host_only.discard(row)
+        else:
+            self._set_row_keys(row)
         self._dirty_rows.add(row)
 
     # -- device sync ----------------------------------------------------------
     def device_arrays(self):
-        """Sync the device mirror and return (bitmaps, ts, exec_ts, kinds,
-        valid) as jnp arrays of shape [cap, ...]."""
         import jax.numpy as jnp
-        from accord_tpu.ops.kernels import bucket_size, pad_to
+        from accord_tpu.ops.kernels import arena_scatter, bucket_size
         if self._device is None:
-            self._device = tuple(jnp.asarray(a) for a in (
-                self.bitmaps, self.ts, self.exec_ts, self.kinds, self.valid))
-            self._dirty_rows.clear()
-            self._device_count = self.count
-            return self._device
+            neg = np.iinfo(np.int32).min
+            self._device = (
+                jnp.zeros((self.cap, self.num_buckets), jnp.float32),
+                jnp.zeros((self.cap, 3), jnp.int32),
+                jnp.full((self.cap, 3), neg, jnp.int32),
+                jnp.zeros(self.cap, jnp.int32),
+                jnp.zeros(self.cap, bool),
+            )
+            self._dirty_rows = set(range(self.count))
         if self._dirty_rows:
-            from accord_tpu.ops.kernels import scatter_rows
             rows = sorted(self._dirty_rows)
-            m = bucket_size(len(rows))
-            # pad by repeating the first dirty row: duplicate scatter indexes
-            # then write identical (correct) data, so padding is harmless
-            idx = np.full(m, rows[0], dtype=np.int32)
-            idx[:len(rows)] = rows
-            jidx = jnp.asarray(idx)
-            self._device = tuple(
-                scatter_rows(dev, jidx, jnp.asarray(host[idx]))
-                for dev, host in zip(self._device,
-                                     (self.bitmaps, self.ts, self.exec_ts,
-                                      self.kinds, self.valid)))
+            # chunked so the jit shape tiers stay few and warmable ({8, 64})
+            for lo in range(0, len(rows), 64):
+                chunk = rows[lo:lo + 64]
+                m = 8 if len(chunk) <= 8 else 64
+                # pad by repeating the first dirty row: duplicate scatter
+                # indexes write identical (correct) data -- harmless
+                idx = np.full(m, chunk[0], dtype=np.int32)
+                idx[:len(chunk)] = chunk
+                self._device = arena_scatter(
+                    *self._device, jnp.asarray(idx),
+                    jnp.asarray(self.keys_mod[idx]),
+                    jnp.asarray(self.ts[idx]), jnp.asarray(self.exec_ts[idx]),
+                    jnp.asarray(self.kinds[idx]), jnp.asarray(self.valid[idx]))
             self._dirty_rows.clear()
-            self._device_count = self.count
         return self._device
 
 
+def _subject_tier(n: int) -> int:
+    """Subject-batch padding tiers -- deliberately few ({8, 64}, then pow2)
+    so the jit cache stays tiny and warmup() can cover it."""
+    if n <= 8:
+        return 8
+    if n <= 64:
+        return 64
+    from accord_tpu.ops.kernels import bucket_size
+    return bucket_size(n, 128)
+
+
+class _Item:
+    """One queued resolution (a PreAccept's deps or a standalone deps query)."""
+
+    __slots__ = ("store", "txn_id", "owned", "before", "out", "outcome",
+                 "chunks")
+
+    def __init__(self, store, txn_id, owned, before, out, outcome=None):
+        self.store = store
+        self.txn_id = txn_id
+        self.owned = owned          # Keys (the store's slice of the subject)
+        self.before = before
+        self.out = out              # AsyncResult
+        self.outcome = outcome      # preaccept outcome (None for deps query)
+        self.chunks: List[int] = []  # subject-row indices in the dispatch
+
+
+class _Call:
+    __slots__ = ("packed", "items", "arena")
+
+    def __init__(self, packed, items, arena):
+        self.packed = packed
+        self.items = items
+        self.arena = arena
+
+
 class BatchDepsResolver(DepsResolver):
-    def __init__(self, num_buckets: int = 256):
+    MAX_DISPATCH = 64   # subjects per kernel call (keeps jit tiers bounded)
+
+    def __init__(self, num_buckets: int = 256, initial_cap: int = 4096):
         import jax.numpy as jnp
         self.num_buckets = num_buckets
+        self.initial_cap = initial_cap
         self._table = jnp.asarray(WITNESS_TABLE)
-        self._states: Dict[int, _StoreDeviceState] = {}
+        self._arenas: Dict[int, _NodeArena] = {}
+        self._adopted: set = set()
+        self._pa_queues: Dict[int, list] = {}
+        self._deps_queues: Dict[int, list] = {}
+        self._ticking: set = set()
+        # bench counters
+        self.dispatches = 0
+        self.subjects = 0
+        self.harvest_stall_s = 0.0   # blocking on the async transfer
+        self.decode_s = 0.0          # host-side result materialization
 
-    def _state(self, store) -> _StoreDeviceState:
-        st = self._states.get(id(store))
-        if st is None:
-            st = _StoreDeviceState(self.num_buckets)
+    # -- arena plumbing -------------------------------------------------------
+    def _arena(self, store) -> _NodeArena:
+        node = store.node
+        arena = self._arenas.get(id(node))
+        if arena is None:
+            arena = _NodeArena(self.num_buckets, self.initial_cap)
+            self._arenas[id(node)] = arena
+        if id(store) not in self._adopted:
+            self._adopted.add(id(store))
             # adopt anything registered before the resolver was attached
-            # (update() routes INVALIDATED adoptions through append + the
-            # valid=False lane, matching the host scan's exclusion)
             for key, cfk in store.cfks.items():
                 for t, info in cfk._infos.items():
-                    st.update(t, (key,),
-                              info.status,
-                              info.execute_at or t.as_timestamp())
-            self._states[id(store)] = st
-        return st
+                    arena.update(t, (key,), info.status,
+                                 info.execute_at or t.as_timestamp())
+        return arena
 
-    # -- observer hook (store.register funnel) --------------------------------
+    # -- observer hooks (store.register funnel) -------------------------------
     def on_register(self, store, txn_id: TxnId, keys, status: CfkStatus,
                     witnessed_at: Timestamp) -> None:
         if not isinstance(keys, Keys):
             return  # range-domain txns stay host-side
-        st = self._state(store)
-        st.update(txn_id, tuple(sorted(keys)), status, witnessed_at)
+        self._arena(store).update(txn_id, set(keys), status, witnessed_at)
 
     def on_truncate(self, store, txn_id: TxnId) -> None:
-        st = self._states.get(id(store))
-        if st is None:
+        arena = self._arenas.get(id(store.node))
+        if arena is None:
             return
-        row = st.row_of.get(txn_id)
-        if row is not None:
-            # deps must stop including it (the host cfk scan no longer does);
-            # exec_ts stays -- MaxConflicts is monotone
-            st.valid[row] = False
-            st._dirty_rows.add(row)
+        row = arena.row_of.get(txn_id)
+        if row is None:
+            return
+        mine = {k for k in arena.key_sets[row]
+                if store.slice_ranges.contains_key(k)}
+        arena.remove_keys(txn_id, mine)
 
-    # -- SPI ----------------------------------------------------------------
+    # -- async batched path (the hot path) ------------------------------------
+    def enqueue_preaccept(self, store, txn_id, partial_txn, route,
+                          ballot) -> AsyncResult:
+        out: AsyncResult = AsyncResult()
+        node = store.node
+        self._pa_queues.setdefault(id(node), []).append(
+            (store, txn_id, partial_txn, route, ballot, out))
+        self._schedule_tick(store)
+        return out
+
+    def enqueue_deps(self, store, txn_id, seekables, before) -> AsyncResult:
+        out: AsyncResult = AsyncResult()
+        node = store.node
+        self._deps_queues.setdefault(id(node), []).append(
+            (store, txn_id, seekables, before, out))
+        self._schedule_tick(store)
+        return out
+
+    def _schedule_tick(self, store) -> None:
+        node = store.node
+        if id(node) in self._ticking:
+            return
+        self._ticking.add(id(node))
+        node.scheduler.once(store.batch_window_ms, lambda: self._tick(node))
+
+    def _tick(self, node) -> None:
+        from accord_tpu.local import commands
+        from accord_tpu.local.commands import AcceptOutcome
+        self._ticking.discard(id(node))
+        pa = self._pa_queues.pop(id(node), [])
+        dq = self._deps_queues.pop(id(node), [])
+        items: List[_Item] = []
+        # host preaccept phase: registrations land in the arena immediately,
+        # so batchmates witness each other (deps may be any conservative
+        # superset; execution still orders by executeAt)
+        for (store, t, p, route, ballot, out) in pa:
+            try:
+                outcome = commands.preaccept(store, t, p, route, ballot)
+            except BaseException as e:  # noqa: BLE001
+                out.try_set_failure(e)
+                continue
+            if outcome in (AcceptOutcome.REJECTED_BALLOT,
+                           AcceptOutcome.TRUNCATED):
+                out.try_set_success((outcome, None, None))
+                continue
+            items.append(_Item(store, t, store.owned(p.keys),
+                               store.command(t).execute_at, out, outcome))
+        for (store, t, ks, before, out) in dq:
+            items.append(_Item(store, t, store.owned(ks), before, out))
+        # split oversized batches so subject-bucket jit tiers stay bounded
+        # (8..MAX_DISPATCH); each slice is its own pipelined call
+        for lo in range(0, len(items), self.MAX_DISPATCH):
+            self._dispatch(node, items[lo:lo + self.MAX_DISPATCH])
+
+    def _encode_and_run(self, arena: _NodeArena, items: List[_Item]):
+        """Chunk subjects, build the compact upload arrays, run the fused
+        kernel. Shared by the async dispatch and the sync path -- the two
+        must never drift. Returns the (device) packed result array."""
+        import jax.numpy as jnp
+        from accord_tpu.ops.kernels import deps_resolve, pad_to
+        subj_keys: List[List[int]] = []
+        subj_before: List[Timestamp] = []
+        subj_kinds: List[int] = []
+        for item in items:
+            ks = sorted(int(k) for k in item.owned)
+            for lo in range(0, max(len(ks), 1), _NodeArena.MAXK):
+                chunk = ks[lo:lo + _NodeArena.MAXK]
+                item.chunks.append(len(subj_keys))
+                subj_keys.append(chunk)
+                subj_before.append(item.before)
+                subj_kinds.append(int(item.txn_id.kind))
+        padded = _subject_tier(len(subj_keys))
+        sk = np.full((padded, _NodeArena.MAXK), -1, dtype=np.int32)
+        for i, chunk in enumerate(subj_keys):
+            mods = sorted({k % self.num_buckets for k in chunk})
+            sk[i, :len(mods)] = mods
+        act_bm, act_ts, _, act_kinds, act_valid = arena.device_arrays()
+        return deps_resolve(
+            jnp.asarray(sk),
+            jnp.asarray(pad_to(arena.encoder.encode(subj_before), padded)),
+            jnp.asarray(pad_to(np.asarray(subj_kinds, np.int32), padded)),
+            act_bm, act_ts, act_kinds, act_valid, self._table)
+
+    def _decode_item(self, arena: _NodeArena, item: _Item, packed) -> Deps:
+        """Recover one subject's exact key-domain deps from the bit-packed
+        kernel result. Shared by harvest and the sync path."""
+        from accord_tpu.primitives.deps import KeyDeps
+        if packed is None:
+            kd = KeyDeps.EMPTY
+        else:
+            prow = packed[item.chunks[0]]
+            for c in item.chunks[1:]:
+                prow = prow | packed[c]
+            kd = arena.decode_packed(item.txn_id, sorted(item.owned), prow)
+        if not arena.host_only:
+            return Deps(kd)
+        # rows too wide for the device (> MAXK keys) are scanned host-side
+        kb = KeyDepsBuilder()
+        subj_set = set(item.owned)
+        for j in arena.host_only:
+            if j in arena.invalidated:
+                continue  # host scan excludes invalidated deps too
+            dep_id = arena.txn_ids[j]
+            if dep_id != item.txn_id and dep_id < item.before \
+                    and item.txn_id.kind.witnesses(dep_id.kind):
+                for k in arena.key_sets[j] & subj_set:
+                    kb.add(k, dep_id)
+        return Deps(kd.union(kb.build()))
+
+    def _dispatch(self, node, items: List[_Item]) -> None:
+        for item in items:
+            self._arena(item.store)  # ensure adoption of late-attached stores
+        arena = self._arenas.get(id(node))
+        if arena is None or arena.count == 0:
+            call = _Call(None, items, arena or _NodeArena(self.num_buckets, 8))
+        else:
+            packed = self._encode_and_run(arena, items)
+            packed.copy_to_host_async()
+            call = _Call(packed, items, arena)
+        self.dispatches += 1
+        self.subjects += len(items)
+        delay = getattr(node, "device_latency_ms", 4.0)
+        node.scheduler.once(delay, lambda: self._harvest(call))
+
+    def _harvest(self, call: _Call) -> None:
+        import time as _time
+        packed = None
+        if call.packed is not None:
+            t0 = _time.perf_counter()
+            packed = np.asarray(call.packed)
+            self.harvest_stall_s += _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        results = []
+        for item in call.items:
+            store = item.store
+            deps = self._decode_item(call.arena, item, packed)
+            if store.range_txns:
+                deps = deps.union(store.host_range_deps(
+                    item.txn_id, item.owned, item.before))
+            results.append(store.inject_dep_floor(item.txn_id, item.owned,
+                                                  deps))
+        self.decode_s += _time.perf_counter() - t0
+        for item, deps in zip(call.items, results):
+            if item.outcome is not None:
+                item.out.try_set_success((item.outcome, item.before, deps))
+            else:
+                item.out.try_set_success(deps)
+
+    # -- synchronous SPI (tests, rare recovery-path callers) ------------------
     def resolve_one(self, store, txn_id, seekables, before) -> Deps:
         if not isinstance(seekables, Keys):
             # range-domain subjects stay on the host path for now
             return store.host_calculate_deps(txn_id, seekables, before)
         owned = store.owned(seekables)
-        rows = self.resolve_batch(store, [(txn_id, owned, before)])
-        deps = rows[0]
+        deps = self.resolve_batch(store, [(txn_id, owned, before)])[0]
         if store.range_txns:
             # range txns are tracked host-side; union ONLY those in (the
             # device result already has the key-domain deps exactly)
@@ -258,44 +590,31 @@ class BatchDepsResolver(DepsResolver):
 
     def resolve_batch(self, store,
                       subjects: Sequence[Tuple[TxnId, Keys, Timestamp]]) -> List[Deps]:
-        """Resolve deps for a micro-batch of (txn_id, owned keys, before)."""
-        import jax.numpy as jnp
-        from accord_tpu.ops.kernels import bucket_size, deps_matrix, pad_to
-        st = self._state(store)
-        if st.count == 0:
+        """Synchronous resolve (dispatch + immediate harvest): exact host
+        parity, used by differential tests and the rare non-batched callers."""
+        arena = self._arena(store)
+        if arena.count == 0:
             return [Deps.NONE for _ in subjects]
-        b = len(subjects)
-        padded_b = bucket_size(b)
-        bitmaps = encode_key_bitmaps([tuple(kk) for _, kk, _ in subjects],
-                                     self.num_buckets)
-        before_ts = st.encoder.encode([bound for _, _, bound in subjects])
-        kinds = np.array([int(t.kind) for t, _, _ in subjects], dtype=np.int32)
-        act_bm, act_ts, _, act_kinds, act_valid = st.device_arrays()
-        matrix = deps_matrix(
-            jnp.asarray(pad_to(bitmaps, padded_b)),
-            jnp.asarray(pad_to(before_ts, padded_b)),
-            jnp.asarray(pad_to(kinds, padded_b)),
-            act_bm, act_ts, act_kinds, act_valid, self._table)
-        matrix = np.asarray(matrix)[:b, :st.count]
-        out: List[Deps] = []
-        for i, (subj_id, subj_keys, _) in enumerate(subjects):
-            kb = KeyDepsBuilder()
-            subj_set = set(subj_keys)
-            for j in np.nonzero(matrix[i])[0]:
-                dep_id = st.txn_ids[j]
-                if dep_id == subj_id:
-                    continue  # device compares by (ts) bound; exclude self
-                # exact per-key recovery: bucket collisions filtered here
-                for k in st.key_sets[j]:
-                    if k in subj_set:
-                        kb.add(k, dep_id)
-            out.append(Deps(kb.build()))
-        return out
+        items = [_Item(store, t, owned, before, None)
+                 for (t, owned, before) in subjects]
+        packed = np.asarray(self._encode_and_run(arena, items))
+        return [self._decode_item(arena, item, packed) for item in items]
 
-    # -- max-conflict (device path for preaccept_timestamp) ------------------
+    # -- max-conflict (device path; inline mode + bench only) ----------------
     def max_conflict(self, store, txn_id: TxnId,
                      seekables: Seekables) -> Tuple[bool, Optional[Timestamp]]:
         if not isinstance(seekables, Keys):
+            return False, None
+        if store.batch_window_ms is not None:
+            # batched mode: witness timestamps come from the O(1) host
+            # MaxConflicts map inside the tick -- a synchronous device call
+            # here would serialize the pipeline on the tunnel round trip
+            return False, None
+        arena = self._arenas.get(id(store.node))
+        if arena is not None and (arena.had_truncation or arena.host_only):
+            # truncation shrinks bitmap rows and host_only rows (> MAXK keys)
+            # have no device bitmap at all: either way the (monotone) device
+            # max-conflict could understate -- the host decides
             return False, None
         res = self.max_conflict_batch(store, [(txn_id, seekables)])
         return res[0]
@@ -306,15 +625,16 @@ class BatchDepsResolver(DepsResolver):
         collision false positive (row's real keys don't intersect) falls back
         to the host scan for that subject (rare)."""
         import jax.numpy as jnp
+        from accord_tpu.ops.encoding import encode_key_bitmaps
         from accord_tpu.ops.kernels import bucket_size, max_conflict, pad_to
-        st = self._state(store)
-        if st.count == 0:
+        arena = self._arena(store)
+        if arena.count == 0:
             return [(True, None) for _ in subjects]
         b = len(subjects)
         padded_b = bucket_size(b)
         bitmaps = encode_key_bitmaps([tuple(kk) for _, kk in subjects],
                                      self.num_buckets)
-        act_bm, _, act_exec, _, act_valid = st.device_arrays()
+        act_bm, _, act_exec, _, act_valid = arena.device_arrays()
         # registered rows count even when invalidated (MaxConflicts is
         # monotone in the reference); valid lane is NOT applied here
         all_rows = jnp.ones_like(act_valid)
@@ -325,12 +645,12 @@ class BatchDepsResolver(DepsResolver):
         out: List[Tuple[bool, Optional[Timestamp]]] = []
         for i, (subj_id, subj_keys) in enumerate(subjects):
             j = int(rows[i])
-            if j < 0 or j >= st.count:
+            if j < 0 or j >= arena.count:
                 out.append((True, None))
                 continue
             subj_set = set(subj_keys)
-            if any(k in subj_set for k in st.key_sets[j]):
-                out.append((True, st.exec_max[j]))
+            if any(k in subj_set for k in arena.key_sets[j]):
+                out.append((True, arena.exec_max[j]))
             else:
                 out.append((False, None))  # bucket collision: host decides
         return out

@@ -139,7 +139,11 @@ class KeyDepsBuilder:
         self._map: Dict[Key, set] = {}
 
     def add(self, key: Key, txn_id: TxnId) -> "KeyDepsBuilder":
-        self._map.setdefault(key, set()).add(txn_id)
+        s = self._map.get(key)
+        if s is None:
+            self._map[key] = {txn_id}
+        else:
+            s.add(txn_id)
         return self
 
     def add_all(self, key: Key, txn_ids: Iterable[TxnId]) -> "KeyDepsBuilder":

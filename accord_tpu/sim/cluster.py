@@ -26,10 +26,11 @@ class ClusterConfig:
     def __init__(self, num_nodes: int = 3, rf: int = 3, num_shards: int = 4,
                  key_domain: int = 1 << 16, stores_per_node: int = 2,
                  timeout_ms: float = 1000.0, deps_resolver_factory=None,
-                 deps_batch_window_ms=0.0,
+                 deps_batch_window_ms=0.0, device_latency_ms: float = 4.0,
                  progress: bool = True, progress_interval_ms: float = 250.0,
                  progress_stall_ms: float = 1500.0, serialize: bool = True,
-                 durability: bool = False, durability_interval_ms: float = 500.0):
+                 durability: bool = False, durability_interval_ms: float = 500.0,
+                 preaccept_timeout_ms: float = 1000.0):
         self.num_nodes = num_nodes
         self.rf = min(rf, num_nodes)
         self.num_shards = num_shards
@@ -39,6 +40,7 @@ class ClusterConfig:
         # factory() -> DepsResolver; None = host scan (the reference path)
         self.deps_resolver_factory = deps_resolver_factory
         self.deps_batch_window_ms = deps_batch_window_ms  # None = inline
+        self.device_latency_ms = device_latency_ms  # async harvest delay
         self.progress = progress  # enable the liveness/recovery engine
         self.progress_interval_ms = progress_interval_ms
         self.progress_stall_ms = progress_stall_ms
@@ -47,6 +49,9 @@ class ClusterConfig:
         # the burn enables them and stops them at workload completion
         self.durability = durability
         self.durability_interval_ms = durability_interval_ms
+        # preaccept expiry (Agent.pre_accept_timeout_ms); high-concurrency
+        # benches raise it together with the network timeout
+        self.preaccept_timeout_ms = preaccept_timeout_ms
 
 
 def build_topology(cfg: ClusterConfig, epoch: int = 1) -> Topology:
@@ -148,6 +153,9 @@ class SimAgent(Agent):
             (self.node_id, AssertionError(
                 f"inconsistent timestamp for {command}: {prev} vs {next_ts}")))
 
+    def pre_accept_timeout_ms(self) -> float:
+        return self.cluster.config.preaccept_timeout_ms
+
 
 class Cluster:
     def __init__(self, seed: int, config: Optional[ClusterConfig] = None):
@@ -190,6 +198,7 @@ class Cluster:
                 deps_resolver=(self.config.deps_resolver_factory()
                                if self.config.deps_resolver_factory else None),
                 deps_batch_window_ms=self.config.deps_batch_window_ms,
+                device_latency_ms=self.config.device_latency_ms,
             )
             if engine is not None:
                 engine.bind(node)

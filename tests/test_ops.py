@@ -155,6 +155,27 @@ def test_burn_with_batched_device_resolver():
     assert a.log == b.log  # deterministic under batching
 
 
+def test_batch_resolver_dense_conflicts_vs_host():
+    """Subjects with dependency counts in the hundreds (everything conflicts)
+    must still decode exactly from the bit-packed kernel result."""
+    from accord_tpu.ops.resolver import BatchDepsResolver
+    from accord_tpu.primitives.keyspace import Keys
+    from tests.test_local_engine import setup_store
+    _, node, store = setup_store()
+    # 150 txns all on one key: every subject conflicts with every earlier one
+    keys_list = [[0, 1] for _ in range(150)]
+    ids = _preaccept_population(store, node, keys_list)
+    resolver = BatchDepsResolver(num_buckets=128)
+    for i in (120, 130, 149):
+        subject = ids[i]
+        keys = Keys(keys_list[i])
+        bound = store.command(subject).execute_at
+        host = store.host_calculate_deps(subject, keys, bound)
+        dev = resolver.resolve_one(store, subject, keys, bound)
+        assert dev == host, f"subject {subject}"
+        assert len(host.key_deps.all_txn_ids()) > 64  # genuinely dense
+
+
 def test_max_conflict_batch_vs_host():
     """Device max-conflict must agree with the host MaxConflicts scan."""
     from accord_tpu.ops.resolver import BatchDepsResolver
