@@ -669,11 +669,20 @@ class MaybeRecover(Callback):
         for store in self.node.command_stores.all():
             if not store.owns(scope):
                 continue
-            cmd = store.command_if_present(self.txn_id)
-            if cmd is None or cmd.status.is_terminal \
-                    or cmd.has_been(_S.APPLIED):
+            # create the record if absent: the engine (and any future waiter
+            # resurrecting the id) needs the terminal status to be LOCALLY
+            # visible, else it re-probes a cluster-wide truncation forever
+            cmd = store.command(self.txn_id)
+            if cmd.status.is_terminal or cmd.has_been(_S.APPLIED):
                 continue
-            if self.txn_id.kind.is_write:
+            if self.txn_id.kind.is_write \
+                    and not store.bootstrap_covers(self.txn_id, scope) \
+                    and store.current_owned().intersects(scope):
+                # a truncated WRITE this store never applied and no snapshot
+                # delivered: its data is missing a durable outcome no
+                # reachable replica still carries -- only a fresh bootstrap
+                # snapshot can repair it. (Skip ranges the store merely lost:
+                # gap-marking them would only poison historical serving.)
                 store.mark_gap(_to_ranges(store.owned(scope)))
             cmd.status = _S.TRUNCATED
             _commands.notify_listeners(store, cmd)

@@ -299,6 +299,12 @@ class _ExecuteRound(Callback):
     """Commit(Stable) to every replica; the read rides on one replica per
     shard (reference: ExecuteTxn.java:84-145 + Commit.stableAndRead)."""
 
+    # a fully-nacked read round is usually TRANSIENT (every replica of some
+    # shard awaiting a bootstrap snapshot during churn): retry the read with
+    # backoff before giving up (reference: ReadCoordinator retry semantics)
+    READ_RETRIES = 4
+    READ_RETRY_BACKOFF_MS = 500.0
+
     def __init__(self, parent: CoordinateTransaction):
         self.parent = parent
         self.stable_tracker = QuorumTracker(parent.topologies, parent.txn.keys)
@@ -306,6 +312,7 @@ class _ExecuteRound(Callback):
         self.needs_read = read is not None and len(tuple(iter(read.keys()))) > 0
         self.read_tracker = (ReadTracker(parent.topologies, read.keys())
                              if self.needs_read else None)
+        self.read_attempts = 0
         self.data = None
         self.data_done = not self.needs_read
 
@@ -334,6 +341,13 @@ class _ExecuteRound(Callback):
                     self.data_done = True
             self._maybe_done()
         elif isinstance(reply, ReadNack):
+            # a Commit-with-read replica commits BEFORE attempting the read
+            # (Commit.process), so ITS nack is still a stable vote -- and
+            # dropping it can leave the stable quorum undecidable with no
+            # timeout pending (a silent hang). A bare ReadTxnData nack
+            # proves nothing about the commit and must not be credited.
+            if reply.committed:
+                self._handle_stable(self.stable_tracker.on_success(from_node))
             self._read_failure(from_node)
 
     def on_failure(self, from_node, failure) -> None:
@@ -348,7 +362,13 @@ class _ExecuteRound(Callback):
             return
         status, more = self.read_tracker.on_read_failure(from_node)
         if status == RequestStatus.FAILED:
-            self.parent._fail(Exhausted(f"read {self.parent.txn_id}"))
+            if self.read_attempts < self.READ_RETRIES:
+                self.read_attempts += 1
+                self.parent.node.scheduler.once(
+                    self.READ_RETRY_BACKOFF_MS * self.read_attempts,
+                    self._retry_read)
+            else:
+                self.parent._fail(Exhausted(f"read {self.parent.txn_id}"))
             return
         p = self.parent
         for to in more:
@@ -356,6 +376,24 @@ class _ExecuteRound(Callback):
         if status == RequestStatus.SUCCESS:
             self.data_done = True
             self._maybe_done()
+
+    def _retry_read(self) -> None:
+        """Retry the read round. Escalates to the CURRENT epoch's replicas:
+        under churn the txn-epoch replicas may all have lost the data (their
+        abandoned bootstrap gaps never heal once the range moved on), while
+        the current owners hold the full history. They may never have heard
+        of the txn, so the retry rides a (idempotent) Commit-with-read that
+        lets them commit, order and serve (the reference's stable-then-read
+        escalation)."""
+        p = self.parent
+        if p.done or self.data_done:
+            return
+        topologies = p.node.topology_manager.with_unsynced_epochs(
+            p.route, p.txn_id.epoch, max(p.txn_id.epoch, p.node.epoch))
+        self.read_tracker = ReadTracker(topologies, p.txn.read.keys())
+        for to in self.read_tracker.initial_contacts(prefer=p.node.id):
+            p.node.send(to, Commit(p.txn_id, p.route, p.txn, p.execute_at,
+                                   p.deps, read=True), self)
 
     def _handle_stable(self, status: RequestStatus) -> None:
         if status == RequestStatus.FAILED:

@@ -24,7 +24,7 @@ from typing import Dict, Optional
 
 from accord_tpu.api import ProgressLog
 from accord_tpu.local.status import Status
-from accord_tpu.primitives.keyspace import Seekables
+from accord_tpu.primitives.keyspace import Keys, Seekables
 from accord_tpu.primitives.timestamp import TxnId
 
 
@@ -119,20 +119,52 @@ class ProgressEngine:
         """Done when every local store owning the participants has the command
         applied or terminal (a truncated record -- dropped below the
         durability floor -- counts as terminal)."""
-        any_store = False
         for store in self.node.command_stores.all():
-            if not store.owns(entry.participants):
+            if not store.current_owned().intersects(entry.participants):
+                # the range moved away (or never arrived): the handover
+                # barrier covered the ordering obligation and the CURRENT
+                # owners carry the liveness one. Leftover records here are
+                # frozen state awaiting floor truncation -- peers may have
+                # erased the outcomes they wait on, so no repair can ever
+                # finish them, and they gate nothing that is still served.
                 continue
-            any_store = True
             cmd = store.command_if_present(entry.txn_id)
+            if cmd is not None and (cmd.has_been(Status.APPLIED)
+                                    or cmd.status.is_terminal):
+                continue
+            if store.is_truncated(entry.txn_id, entry.participants):
+                # below the truncation floor: the outcome is durable
+                # cluster-wide, and the txn will never individually finish
+                # here. A leftover record -- resurrected by a waiter, or a
+                # pre-floor straggler the durability rounds overtook -- is
+                # finished as TRUNCATED so its waiters drop their edges.
+                # (The floor is durable state, so answering probes TRUNCATED
+                # from this record stays truthful.)
+                if cmd is not None and cmd.status != Status.TRUNCATED:
+                    from accord_tpu.local import commands as _commands
+                    if entry.txn_id.kind.is_write and not store.bootstrap_covers(
+                            entry.txn_id, entry.participants):
+                        # a durable write this store never applied and no
+                        # snapshot delivered: its data can only be repaired
+                        # by a future bootstrap
+                        owned = store.owned(entry.participants)
+                        store.mark_gap(owned if not isinstance(owned, Keys)
+                                       else owned.to_ranges())
+                    cmd.status = Status.TRUNCATED
+                    _commands.notify_listeners(store, cmd)
+                    store.progress_log.clear(entry.txn_id)
+                continue
             if cmd is None or cmd.status == Status.NOT_DEFINED:
-                if store.is_truncated(entry.txn_id, entry.participants):
+                if store.bootstrap_covers(entry.txn_id, entry.participants):
+                    # the snapshot delivered the effects and nothing waits
+                    # on the (absent) record: no obligation here. The record
+                    # is NOT marked terminal -- bootstrap coverage is local
+                    # knowledge, and a TRUNCATED answer to probes would
+                    # wrongly assert a cluster-durable outcome was erased.
                     continue
-                if cmd is None:
-                    return False
-            if not (cmd.has_been(Status.APPLIED) or cmd.status.is_terminal):
                 return False
-        return any_store
+            return False
+        return True
 
     def _attempt(self, entry: _Tracked, now: float) -> None:
         from accord_tpu.coordinate.recover import MaybeRecover
@@ -140,6 +172,7 @@ class ProgressEngine:
         entry.attempts += 1
         backoff = self.stall_ms * (2 ** min(entry.attempts, 4))
         entry.next_attempt_ms = now + backoff + self._jitter()
+        self._retrack_blocking_deps(entry)
 
         def done(value, failure):
             entry.in_flight = False
@@ -147,6 +180,29 @@ class ProgressEngine:
 
         MaybeRecover.probe(self.node, entry.txn_id, entry.participants) \
             .add_callback(done)
+
+    def _retrack_blocking_deps(self, entry: _Tracked) -> None:
+        """Blocked-dep tracking is normally established by the one-shot
+        waiting() report, but an ownership race can clear it prematurely: a
+        dep can look locally resolved while a store that gains its range in
+        a LATER epoch resurrects an empty record and blocks on it forever --
+        and probing the waiter alone is always redundant (its outcome is
+        already known locally). Re-derive the waiter's current minimum
+        blocked dependency from its WaitingOn each probe attempt so the
+        repair chain can never be lost."""
+        from accord_tpu.local.commands import _dep_participants
+        for store in self.node.command_stores.all():
+            if not store.current_owned().intersects(entry.participants):
+                continue  # frozen leftover on a lost range: not our liveness
+            cmd = store.command_if_present(entry.txn_id)
+            if cmd is None or cmd.waiting_on is None:
+                continue
+            wo = cmd.waiting_on
+            blocked = min(wo.commit) if wo.commit else (
+                min(wo.apply) if wo.apply else None)
+            if blocked is not None:
+                self.track(blocked, _dep_participants(store, cmd, blocked),
+                           Status.NOT_DEFINED)
 
 
 class StoreProgressLog(ProgressLog):

@@ -56,8 +56,7 @@ class Command:
     __slots__ = (
         "txn_id", "status", "durability", "promised", "accepted_ballot",
         "execute_at", "txn", "route", "deps", "writes", "result",
-        "waiting_on", "waiters", "transient_listeners", "elision_floor_cache",
-        "cleaned",
+        "waiting_on", "waiters", "transient_listeners", "cleaned",
     )
 
     def __init__(self, txn_id: TxnId):
@@ -76,8 +75,6 @@ class Command:
         # commands in the same store whose WaitingOn includes us
         self.waiters: Set[TxnId] = set()
         self.transient_listeners: List[TransientListener] = []
-        # (bootstrapped_at map identity, floor) memo for dep elision
-        self.elision_floor_cache = None
         # tier-A truncation (reference: Cleanup.TRUNCATE_WITH_OUTCOME): the
         # conflict-registry entries (cfk rows, device lanes) were dropped,
         # but the outcome AND deps (txn/executeAt/deps/writes/result) are

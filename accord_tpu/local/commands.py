@@ -227,10 +227,10 @@ def precommit(store: CommandStore, txn_id: TxnId, execute_at: Timestamp) -> None
 def commit_invalidate(store: CommandStore, txn_id: TxnId) -> None:
     """(reference: Commands.commitInvalidate, local/Commands.java:434)"""
     cmd = store.command(txn_id)
-    if cmd.has_been(Status.STABLE) and not cmd.is_(Status.INVALIDATED):
-        Invariants.check_state(False, "invalidating a stable command %s", cmd)
     if cmd.status.is_terminal:
-        return
+        return  # a TRUNCATED record may have been stable; nothing to assert
+    if cmd.has_been(Status.STABLE):
+        Invariants.check_state(False, "invalidating a stable command %s", cmd)
     cmd.status = Status.INVALIDATED
     if cmd.txn is not None:
         store.register(txn_id, cmd.txn.keys, CfkStatus.INVALIDATED, txn_id.as_timestamp())
@@ -277,6 +277,46 @@ def apply(store: CommandStore, txn_id: TxnId, route: Route, txn: Optional[Partia
     return CommitOutcome.SUCCESS
 
 
+def needed_dep_ids(store: CommandStore, cmd: Command) -> Set[TxnId]:
+    """The dep ids that still need a local wait edge, with PER-(key, dep)
+    floor elision: a dep row under key k is elided when k's bootstrap floor
+    (effects arrived with the fetched snapshot) or truncation floor (applied
+    locally before the floor advanced) lies above the dep. A dep keeps its
+    edge iff SOME key it shares with us is unfloored -- strictly sharper than
+    the min-floor-over-all-our-keys rule, which under mixed ownership (one
+    key bootstrapped, another original) elides nothing and leaves waits on
+    deps that can never individually commit here (reference:
+    RedundantBefore's per-range bounds applied in WaitingOn.Update)."""
+    deps = cmd.deps.slice(store.ranges) if cmd.deps is not None else None
+    out: Set[TxnId] = set()
+    if deps is None or deps.is_empty():
+        return out
+    from accord_tpu.local.store import _min_floor_over_range
+
+    def floor_for_key(k):
+        b = store.bootstrapped_at.get(k)
+        t = store.truncated_before.get(k)
+        if b is None:
+            return t
+        if t is None:
+            return b
+        return b if b > t else t
+
+    for k, ids in deps.key_deps.items():
+        f = floor_for_key(k)
+        for d in ids:
+            if d != cmd.txn_id and (f is None or not d < f):
+                out.add(d)
+    for r, ids in deps.range_deps.items():
+        fb = _min_floor_over_range(store.bootstrapped_at, r.start, r.end)
+        ft = _min_floor_over_range(store.truncated_before, r.start, r.end)
+        f = fb if ft is None or (fb is not None and fb > ft) else ft
+        for d in ids:
+            if d != cmd.txn_id and (f is None or not d < f):
+                out.add(d)
+    return out
+
+
 def _init_waiting_on(store: CommandStore, cmd: Command) -> None:
     """Build WaitingOn from deps: every dep on a key/range this store owns
     gates us until it is committed; committed deps executing before us gate us
@@ -289,24 +329,7 @@ def _init_waiting_on(store: CommandStore, cmd: Command) -> None:
     wo = WaitingOn()
     cmd.waiting_on = wo
     awaits_all = cmd.txn_id.kind.awaits_only_deps
-    deps = cmd.deps.slice(store.ranges) if cmd.deps is not None else None
-    if deps is None or deps.is_empty():
-        return
-    for dep_id in deps.all_txn_ids():
-        if dep_id == cmd.txn_id:
-            continue
-        if store.dep_elided_by_floor(cmd, dep_id):
-            # below a bootstrap floor: its effects arrived with the fetched
-            # snapshot; it will never individually apply on this store
-            continue
-        trunc_floor = store.truncation_elision_floor(cmd)
-        if trunc_floor is not None and dep_id.as_timestamp() < trunc_floor:
-            # below the truncation horizon on EVERY shared key: it applied
-            # locally before the floor advanced (redundant_before gates
-            # truncation) or it can never commit -- no wait edge needed.
-            # (min-floor semantics: a dep sharing only unfloored keys keeps
-            # its edge)
-            continue
+    for dep_id in needed_dep_ids(store, cmd):
         dep = store.command(dep_id)
         if dep.is_(Status.INVALIDATED):
             continue

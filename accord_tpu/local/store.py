@@ -344,6 +344,22 @@ class CommandStore:
             floor_map.covers(r.start, r.end, lambda f: ts < f)
             for r in _as_ranges(owned))
 
+    def bootstrap_covers(self, txn_id: TxnId, seekables: Seekables) -> bool:
+        """Did this store's bootstrap snapshot deliver the txn's effects on
+        every owned participant? (ALL owned keys floored above the id: the
+        txn will never individually commit/apply here, and nothing needs to.)"""
+        if self.bootstrapped_at.is_empty():
+            return False
+        ts = txn_id.as_timestamp()
+        owned = self.owned(seekables)
+        if isinstance(owned, Keys):
+            return len(owned) > 0 and all(
+                (f := self.bootstrapped_at.get(k)) is not None and ts < f
+                for k in owned)
+        return not owned.is_empty() and all(
+            self.bootstrapped_at.covers(r.start, r.end, lambda f: ts < f)
+            for r in _as_ranges(owned))
+
     def cleanup(self) -> None:
         """Two truncation tiers (reference: local/Cleanup.java deciding the
         erase level, Commands.purge):
@@ -394,8 +410,11 @@ class CommandStore:
             # advance the truncation horizon over the whole erased region: ids
             # below it either applied durably, were invalidated, or can never
             # commit (the sync point's reject floor covers new arrivals)
+            prev = self.truncated_before
             self.truncated_before = _merge(self.truncated_before, erase_floor,
                                            Timestamp.merge_max)
+            if self.truncated_before != prev:
+                self.reevaluate_waiters()
 
     def _shrink(self, cmd) -> None:
         # deps are RETAINED: a straggler repairing its copy from our
@@ -435,14 +454,21 @@ class CommandStore:
         for r in ranges:
             self.bootstrapped_at = self.bootstrapped_at.with_range(
                 r.start, r.end, ts, Timestamp.merge_max)
+        self.reevaluate_waiters()
+
+    def reevaluate_waiters(self) -> None:
+        """A floor advanced (bootstrap or truncation): previously-registered
+        wait edges may now be elided -- recompute each waiter's needed set
+        and release the ones that became complete."""
         from accord_tpu.local import commands as _commands
         for cmd in list(self.commands.values()):
             wo = cmd.waiting_on
-            if wo is None:
+            if wo is None or wo.is_done():
                 continue
+            needed = _commands.needed_dep_ids(self, cmd)
             changed = False
             for dep_id in list(wo.commit | wo.apply):
-                if self.dep_elided_by_floor(cmd, dep_id):
+                if dep_id not in needed:
                     wo.commit.discard(dep_id)
                     wo.apply.discard(dep_id)
                     changed = True
@@ -476,62 +502,6 @@ class CommandStore:
             out = out.union(Ranges([r]).difference(floored))
         return out
 
-    def dep_elided_by_floor(self, cmd, dep_id: TxnId) -> bool:
-        """True when the dep's effects came with a bootstrap snapshot, so it
-        will never individually apply here. A dep gates the waiter only
-        through keys both own in this store; if EVERY owned key of the waiter
-        is floored above the dep, every shared key is too -- safe to elide."""
-        floor = self.elision_floor(cmd)
-        return floor is not None and dep_id.as_timestamp() < floor
-
-    def elision_floor(self, cmd) -> Optional[Timestamp]:
-        """min bootstrap floor over the waiter's owned keys (None when any
-        owned key is unfloored): deps strictly below it are elided. Cached on
-        the command, invalidated when the floor map advances."""
-        if self.bootstrapped_at.is_empty() or cmd.txn is None:
-            return None
-        cached = cmd.elision_floor_cache
-        if cached is not None and cached[0] is self.bootstrapped_at \
-                and cached[1] is cmd.txn and cached[2] is self._owned_union:
-            return cached[3]
-        floor = self._min_floor_over(cmd, self.bootstrapped_at)
-        cmd.elision_floor_cache = (self.bootstrapped_at, cmd.txn,
-                                   self._owned_union, floor)
-        return floor
-
-    def truncation_elision_floor(self, cmd) -> Optional[Timestamp]:
-        """min truncation floor over the waiter's owned keys (None when any
-        owned key is unfloored). Deps strictly below it are safe to skip:
-        every shared key is below a durability sync point that witnessed and
-        waited out the dep, so its effects applied here before the floor
-        advanced. (ANY-key semantics would skip deps sharing only unfloored
-        keys -- a serializability hole.)"""
-        if self.truncated_before.is_empty() or cmd.txn is None:
-            return None
-        return self._min_floor_over(cmd, self.truncated_before)
-
-    def _min_floor_over(self, cmd, floor_map: ReducingRangeMap) -> Optional[Timestamp]:
-        owned = self.owned(cmd.txn.keys)
-        out: Optional[Timestamp] = None
-        if isinstance(owned, Keys):
-            if len(owned) == 0:
-                return None
-            for k in owned:
-                f = floor_map.get(k)
-                if f is None:
-                    return None
-                out = f if out is None or f < out else out
-            return out
-        if owned.is_empty():
-            return None
-        # every point of every owned range must be floored; take the min
-        for r in _as_ranges(owned):
-            f = _min_floor_over_range(floor_map, r.start, r.end)
-            if f is None:
-                return None
-            out = f if out is None or f < out else out
-        return out
-
     def is_rejected_if_not_preaccepted(self, txn_id: TxnId,
                                        seekables: Seekables) -> bool:
         """Would the reject floor refuse this txn were it arriving now?
@@ -559,23 +529,30 @@ class CommandStore:
             raw = self.deps_resolver.resolve_one(self, txn_id, seekables, before)
         else:
             raw = self.host_calculate_deps(txn_id, seekables, before)
-        return self.inject_dep_floor(txn_id, seekables, raw)
+        return self.inject_dep_floor(txn_id, seekables, raw, before)
 
     def inject_dep_floor(self, txn_id: TxnId, seekables: Seekables,
-                         deps: Deps) -> Deps:
+                         deps: Deps, before: Timestamp) -> Deps:
         """Replace deps below the locally-applied ExclusiveSyncPoint floor
         with a single dep on the floor ESP itself (reference:
         RedundantBefore.collectDeps, local/RedundantBefore.java:49): the ESP
         witnessed and waited out everything below it, so one edge to it
         carries the same ordering with O(1) size. This is what keeps dep sets
         bounded by the inter-durability-round arrival rate instead of the
-        total live-txn count."""
+        total live-txn count.
+
+        Only floors STRICTLY BELOW the subject's started-before bound apply:
+        injecting a LATER sync point as a dep of an EARLIER subject inverts
+        the order, and two awaits-all sync points pointing at each other
+        deadlock (observed under churn+chaos+durability: a laggard ESP's
+        deps query ran after a newer durability ESP had already applied)."""
         rb = self.redundant_before
         if rb.is_empty():
             return deps
         owned = self.owned(seekables)
         if isinstance(owned, Keys):
-            floors = [(k, f) for k in owned if (f := rb.get(k)) is not None]
+            floors = [(k, f) for k in owned
+                      if (f := rb.get(k)) is not None and f < before]
             if not floors:
                 return deps
             edges = KeyDepsBuilder()
@@ -608,18 +585,22 @@ class CommandStore:
         rbld = RangeDepsBuilder()
         for r, ids in deps.range_deps.items():
             fmin = _min_floor_over_range(rb, r.start, r.end)
+            if fmin is not None and not fmin < before:
+                fmin = None
             kept = ids if fmin is None else [t for t in ids if not t < fmin]
             if kept:
                 rbld.add_all(r, kept)
         for rr in _as_ranges(owned):
             for s, e, f in rb.segments():
                 lo, hi = max(s, rr.start), min(e, rr.end)
-                if lo < hi and f is not None:
+                if lo < hi and f is not None and f < before:
                     fid = TxnId.from_timestamp(f)
                     if fid != txn_id:
                         rbld.add(Range(lo, hi), fid)
         for k, ids in deps.key_deps.items():
             f = rb.get(k)
+            if f is not None and not f < before:
+                f = None
             kept = ids if f is None else [t for t in ids if not t < f]
             if kept:
                 kb.add_all(k, kept)

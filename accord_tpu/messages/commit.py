@@ -38,8 +38,11 @@ class Commit(Request):
         def after(reply):
             if self.read:
                 # overlap commit with execution: reply with the read result
+                # (committed=True: even a nack is a stable vote, the commit
+                # above already processed)
                 execute_read_when_ready(node, self.txn_id, self.txn,
-                                        self.execute_at, from_node, reply_context)
+                                        self.execute_at, from_node,
+                                        reply_context, committed=True)
             else:
                 node.reply(from_node, reply_context, reply)
 

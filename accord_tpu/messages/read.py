@@ -30,13 +30,19 @@ class ReadOk(Reply):
 
 
 class ReadNack(Reply):
-    __slots__ = ("txn_id",)
+    """`committed` distinguishes the two nack sources for the coordinator's
+    stable tracker: a nack from a Commit-with-read arrives AFTER the commit
+    was processed (a genuine stable vote); a nack from a bare ReadTxnData
+    proves nothing about the commit."""
 
-    def __init__(self, txn_id: TxnId):
+    __slots__ = ("txn_id", "committed")
+
+    def __init__(self, txn_id: TxnId, committed: bool = False):
         self.txn_id = txn_id
+        self.committed = committed
 
     def __repr__(self):
-        return f"ReadNack({self.txn_id!r})"
+        return f"ReadNack({self.txn_id!r}, committed={self.committed})"
 
 
 class _ReadWaiter(TransientListener):
@@ -59,6 +65,17 @@ class _ReadWaiter(TransientListener):
             return
         if command.is_ready_to_execute():
             command.remove_transient_listener(self)
+            # re-check the data gap: a bootstrap that began AFTER this read
+            # started waiting elides pending dep edges (set_bootstrap_floor)
+            # and wakes us before its snapshot has arrived -- serving now
+            # would return data missing acked writes the snapshot carries
+            read_keys = self.txn.read.keys() if self.txn.read is not None else None
+            if read_keys is not None:
+                owned = self.store.owned(read_keys)
+                if len(owned) > 0 and self.store.has_gap(owned.to_ranges()):
+                    self.result.try_set_failure(
+                        RuntimeError(f"{command.txn_id} data gap"))
+                    return
             self.result.try_set_success(_do_read(self.store, self.txn, self.execute_at))
 
 
@@ -87,7 +104,8 @@ def _read_one_store(store, txn_id: TxnId, txn: Txn, execute_at: Timestamp) -> As
 
 
 def execute_read_when_ready(node, txn_id: TxnId, txn: Txn, execute_at: Timestamp,
-                            from_node, reply_context) -> None:
+                            from_node, reply_context,
+                            committed: bool = False) -> None:
     stores = node.command_stores.intersecting(txn.keys)
     read_keys = txn.read.keys() if txn.read is not None else None
     if read_keys is not None:
@@ -101,7 +119,8 @@ def execute_read_when_ready(node, txn_id: TxnId, txn: Txn, execute_at: Timestamp
         for s in stores:
             owned = s.owned(read_keys)
             if len(owned) > 0 and s.has_gap(owned.to_ranges()):
-                node.reply(from_node, reply_context, ReadNack(txn_id))
+                node.reply(from_node, reply_context,
+                           ReadNack(txn_id, committed))
                 return
     waits = [_read_one_store(s, txn_id, txn, execute_at) for s in stores]
 
@@ -113,7 +132,8 @@ def execute_read_when_ready(node, txn_id: TxnId, txn: Txn, execute_at: Timestamp
         node.reply(from_node, reply_context, ReadOk(txn_id, data))
 
     all_of(waits).on_success(merge) \
-        .on_failure(lambda _: node.reply(from_node, reply_context, ReadNack(txn_id)))
+        .on_failure(lambda _: node.reply(from_node, reply_context,
+                                         ReadNack(txn_id, committed)))
 
 
 class ReadTxnData(Request):

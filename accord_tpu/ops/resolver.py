@@ -567,7 +567,7 @@ class BatchDepsResolver(DepsResolver):
                 deps = deps.union(store.host_range_deps(
                     item.txn_id, item.owned, item.before))
             results.append(store.inject_dep_floor(item.txn_id, item.owned,
-                                                  deps))
+                                                  deps, item.before))
         self.decode_s += _time.perf_counter() - t0
         for item, deps in zip(call.items, results):
             if item.outcome is not None:

@@ -394,11 +394,16 @@ class Deps:
         return txn_id in self.key_deps.for_key(key) \
             or txn_id in self.range_deps.for_key(key)
 
-    def participants_of(self, txn_id: TxnId) -> Optional[Keys]:
-        """Keys under which txn_id appears (reference: Deps.participants) --
-        where a probe/recovery for it must be addressed."""
+    def participants_of(self, txn_id: TxnId):
+        """Keys (or, for range-deps rows, Ranges) under which txn_id appears
+        (reference: Deps.participants) -- where a probe/recovery for it must
+        be addressed. A sync point's deps live in range rows, so consulting
+        only key rows would leave its blocked deps unprobeable."""
         keys = self.key_deps.participating_keys(txn_id)
-        return keys if not keys.is_empty() else None
+        if not keys.is_empty():
+            return keys
+        rngs = [r for r, ids in self.range_deps.items() if txn_id in ids]
+        return Ranges(rngs) if rngs else None
 
     def union(self, other: "Deps") -> "Deps":
         return Deps(self.key_deps.union(other.key_deps),

@@ -37,6 +37,7 @@ class TopologyRandomizer:
         # copy of a range and every fetch deadlocks
         self.max_pending = max_pending
         self.issued = 0
+        self.mutation_counts: dict = {}  # mutation name -> times applied
         self.stopped = False
         # low-water mark: epochs below this are synced at every node (sync
         # is permanent, so the mark only moves forward -- keeps the per-tick
@@ -96,7 +97,7 @@ class TopologyRandomizer:
         return pending
 
     def _mutate(self, t: Topology) -> Optional[Topology]:
-        choices = [self._move]
+        choices = [self._move, self._electorate, self._bounce_node]
         if len(t.shards) < self.max_shards:
             choices.append(self._split)
         if len(t.shards) > self.min_shards:
@@ -105,6 +106,8 @@ class TopologyRandomizer:
         shards = mutation(list(t.shards))
         if shards is None:
             return None
+        name = mutation.__name__.lstrip("_")
+        self.mutation_counts[name] = self.mutation_counts.get(name, 0) + 1
         return Topology(t.epoch + 1, shards)
 
     def _move(self, shards: List[Shard]) -> Optional[List[Shard]]:
@@ -134,6 +137,54 @@ class TopologyRandomizer:
         shards[i:i + 1] = [Shard(Range(s.range.start, at), s.nodes),
                            Shard(Range(at, s.range.end), s.nodes)]
         return shards
+
+    def _electorate(self, shards: List[Shard]) -> Optional[List[Shard]]:
+        """Mutate a shard's fast-path electorate and joining set (reference:
+        TopologyRandomizer.updateFastPathElectorate/markJoining,
+        test topology/TopologyRandomizer.java:430): shrink the electorate to
+        a random legal subset; excluded replicas are marked `joining` half
+        the time (a replica still syncing data votes no fast path)."""
+        i = self.rng.next_int(len(shards))
+        s = shards[i]
+        rf = len(s.nodes)
+        min_e = rf - (rf - 1) // 2
+        size = min_e + self.rng.next_int(rf - min_e + 1)
+        members = list(s.nodes)
+        # deterministic shuffle via indexed picks
+        electorate = set()
+        while len(electorate) < size:
+            electorate.add(members[self.rng.next_int(rf)])
+        excluded = [n for n in s.nodes if n not in electorate]
+        joining = frozenset(n for n in excluded if self.rng.decide(0.5))
+        new = Shard(s.range, s.nodes, frozenset(electorate), joining)
+        if new == s:
+            return None
+        shards[i] = new
+        return shards
+
+    def _bounce_node(self, shards: List[Shard]) -> Optional[List[Shard]]:
+        """Remove one node from EVERY shard it replicates (the reference's
+        node bounce), substituting a spare replica where one exists -- the
+        substitute must bootstrap the ranges the victim held. The victim
+        stays a live process and re-enters via later move/merge mutations."""
+        all_nodes = sorted(self.cluster.nodes)
+        present = sorted({n for s in shards for n in s.nodes})
+        if not present:
+            return None
+        victim = self.rng.pick(present)
+        changed = False
+        for i, s in enumerate(shards):
+            if victim not in s.nodes:
+                continue
+            nodes = set(s.nodes) - {victim}
+            spare = [n for n in all_nodes if n not in s.nodes]
+            if spare:
+                nodes.add(self.rng.pick(spare))
+            elif not nodes:
+                return None  # single-replica shard with no substitute
+            shards[i] = Shard(s.range, sorted(nodes))
+            changed = True
+        return shards if changed else None
 
     def _merge(self, shards: List[Shard]) -> Optional[List[Shard]]:
         """Merge two adjacent shards; the merged shard takes one side's
