@@ -681,9 +681,13 @@ class MaybeRecover(Callback):
                 # a truncated WRITE this store never applied and no snapshot
                 # delivered: its data is missing a durable outcome no
                 # reachable replica still carries -- only a fresh bootstrap
-                # snapshot can repair it. (Skip ranges the store merely lost:
-                # gap-marking them would only poison historical serving.)
-                store.mark_gap(_to_ranges(store.owned(scope)))
+                # snapshot can repair it. Mark ONLY the currently-owned
+                # slice: gap-marking ranges the store merely lost would
+                # poison historical serving forever (nothing re-bootstraps
+                # a range the store no longer owns).
+                gap = _to_ranges(store.owned(scope)).intersection(
+                    store.current_owned())
+                store.mark_gap(gap)
             cmd.status = _S.TRUNCATED
             _commands.notify_listeners(store, cmd)
             store.progress_log.clear(self.txn_id)

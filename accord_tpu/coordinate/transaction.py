@@ -388,8 +388,13 @@ class _ExecuteRound(Callback):
         p = self.parent
         if p.done or self.data_done:
             return
+        # CURRENT epoch only: spanning down to txn_id.epoch would still
+        # demand data credit from the old shard whose replicas are exactly
+        # the ones gap-nacking -- the escalation must be satisfiable by the
+        # current owners alone
+        epoch = max(p.txn_id.epoch, p.node.epoch)
         topologies = p.node.topology_manager.with_unsynced_epochs(
-            p.route, p.txn_id.epoch, max(p.txn_id.epoch, p.node.epoch))
+            p.route, epoch, epoch)
         self.read_tracker = ReadTracker(topologies, p.txn.read.keys())
         for to in self.read_tracker.initial_contacts(prefer=p.node.id):
             p.node.send(to, Commit(p.txn_id, p.route, p.txn, p.execute_at,

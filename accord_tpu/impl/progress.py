@@ -146,10 +146,19 @@ class ProgressEngine:
                             entry.txn_id, entry.participants):
                         # a durable write this store never applied and no
                         # snapshot delivered: its data can only be repaired
-                        # by a future bootstrap
+                        # by a future bootstrap -- mark only the currently-
+                        # owned slice (lost ranges are never re-bootstrapped,
+                        # so their gap would poison historical serving)
                         owned = store.owned(entry.participants)
-                        store.mark_gap(owned if not isinstance(owned, Keys)
-                                       else owned.to_ranges())
+                        owned = owned if not isinstance(owned, Keys) \
+                            else owned.to_ranges()
+                        store.mark_gap(owned.intersection(
+                            store.current_owned()))
+                    # ORDER MATTERS: status must be terminal BEFORE the
+                    # notify/clear calls -- clear() re-enters this predicate
+                    # for the same entry, and only the terminal status makes
+                    # the re-entrant evaluation (and any re-run of this
+                    # branch) a no-op
                     cmd.status = Status.TRUNCATED
                     _commands.notify_listeners(store, cmd)
                     store.progress_log.clear(entry.txn_id)

@@ -1,0 +1,3 @@
+"""Maelstrom/Jepsen harness: an accord_tpu node speaking Maelstrom's
+JSON-over-stdio protocol (reference: accord-maelstrom, Main.java:60)."""
+from accord_tpu.maelstrom.core import MaelstromNode  # noqa: F401

@@ -1,0 +1,77 @@
+"""Maelstrom executable: JSON lines on stdin/stdout, logs on stderr.
+
+Usage (with Maelstrom/Jepsen):
+  ./maelstrom test -w txn-list-append --bin "python -m accord_tpu.maelstrom" \
+      --node-count 3 --time-limit 30 --rate 100
+
+(reference: accord-maelstrom Main.java:60 listen loop)
+"""
+from __future__ import annotations
+
+import json
+import os
+import select
+import sys
+
+from accord_tpu.maelstrom.core import MaelstromNode
+
+
+def serve(stdin=None, stdout=None, stderr=None) -> int:
+    stdin = stdin or sys.stdin
+    stdout = stdout or sys.stdout
+    stderr = stderr or sys.stderr
+
+    def emit(dest: str, body: dict) -> None:
+        packet = {"src": node.maelstrom_id, "dest": dest, "body": body}
+        stdout.write(json.dumps(packet) + "\n")
+        stdout.flush()
+
+    def log(msg: str) -> None:
+        stderr.write(msg + "\n")
+        stderr.flush()
+
+    node = MaelstromNode(emit, log)
+    # raw fd reads with our own line buffer: select() + buffered readline()
+    # deadlocks (lines sit in the TextIO buffer while select blocks on the fd)
+    fd = stdin.fileno()
+    buf = b""
+    eof = False
+
+    def pump(chunk: bytes) -> None:
+        nonlocal buf
+        buf += chunk
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                node.handle(json.loads(line))
+            except json.JSONDecodeError as e:
+                log(f"bad json: {e}")
+
+    while True:
+        deadline = node.scheduler.next_deadline_us()
+        if eof:
+            if deadline is None:
+                return 0  # timers drained: in-flight work is settled
+            # finish pending coordinations/timeouts before exiting
+            wait = max(0.0, (deadline - node.clock.now_micros()) / 1e6)
+            import time as _t
+            _t.sleep(min(wait, 0.05))
+            node.scheduler.run_due()
+            continue
+        timeout = None if deadline is None else max(
+            0.0, (deadline - node.clock.now_micros()) / 1e6)
+        ready, _, _ = select.select([fd], [], [], timeout)
+        if ready:
+            chunk = os.read(fd, 1 << 16)
+            if not chunk:
+                eof = True
+            else:
+                pump(chunk)
+        node.scheduler.run_due()
+
+
+if __name__ == "__main__":
+    sys.exit(serve())

@@ -1,0 +1,386 @@
+"""The Maelstrom node core: IO-agnostic so the same implementation serves
+the JSON-over-stdio executable (__main__.py) and the in-process Runner the
+tests drive (runner.py).
+
+Role-equivalent to the reference's accord-maelstrom module (Main.java:60,
+Packet.java:39-64, MaelstromRequest/MaelstromReply): a production-shaped
+node for Maelstrom's `txn` workload (micro-ops ["r", k, null] and
+["append", k, v] -- the txn-list-append workload BASELINE.md's configs
+build on). Protocol packets:
+
+  {"src": "c1", "dest": "n1", "body": {"type": "init"|"txn"|..., ...}}
+
+Client txns become one accord transaction (reads of every referenced key +
+per-key appends) coordinated through the full protocol; inter-node accord
+messages ride Maelstrom packets as {"type": "accord"/"accord_reply"} with
+the wire-codec payload base64-encoded in the body.
+"""
+from __future__ import annotations
+
+import base64
+import heapq
+import itertools
+import time as _time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from accord_tpu import api
+from accord_tpu.local.node import Node, TimeService
+from accord_tpu.messages.base import Timeout
+from accord_tpu.primitives.keyspace import Keys, Range, Ranges
+from accord_tpu.primitives.timestamp import TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.sim import wire
+from accord_tpu.sim.list_store import ListQuery, ListRead, ListStore
+from accord_tpu.topology.shard import Shard
+from accord_tpu.topology.topology import Topology
+from accord_tpu.utils.rng import RandomSource
+
+KEY_DOMAIN = 1 << 16
+
+
+# ---------------------------------------------------------------------------
+# Multi-append txn model (Maelstrom txns append DIFFERENT values to
+# DIFFERENT keys in one transaction; the burn's single-value ListUpdate
+# cannot express that).
+# ---------------------------------------------------------------------------
+
+class MultiAppendWrite(api.Write):
+    def __init__(self, appends: Dict[object, Tuple[int, ...]]):
+        self.appends = appends  # key -> values to append, in txn order
+
+    def apply(self, key, store, execute_at) -> None:
+        values = self.appends.get(key)
+        if values:
+            data_store: ListStore = store.node.data_store
+            for v in values:
+                # all values land at the txn's executeAt: idempotent across
+                # replicas (same (at, value) pairs -> same sorted list);
+                # within-txn ties order by value, identically everywhere
+                data_store.append(key, execute_at, v)
+
+
+class MultiAppendUpdate(api.Update):
+    # `value` satisfies ListQuery.compute's result-summary probe (the
+    # maelstrom reply is built from reads + the echoed ops, not from it)
+    value = None
+
+    def __init__(self, appends: Dict[object, Tuple[int, ...]]):
+        self.appends = dict(appends)
+
+    def keys(self) -> Keys:
+        return Keys(self.appends)
+
+    def apply(self, execute_at, data) -> MultiAppendWrite:
+        return MultiAppendWrite(self.appends)
+
+    def slice(self, ranges: Ranges) -> "MultiAppendUpdate":
+        return MultiAppendUpdate({k: v for k, v in self.appends.items()
+                                  if ranges.contains_key(k)})
+
+    def merge(self, other: "MultiAppendUpdate") -> "MultiAppendUpdate":
+        merged = dict(self.appends)
+        merged.update(other.appends)
+        return MultiAppendUpdate(merged)
+
+
+# ---------------------------------------------------------------------------
+# Host SPI implementations (real-time flavored)
+# ---------------------------------------------------------------------------
+
+class WallClock(TimeService):
+    def __init__(self):
+        self._last = 0
+
+    def now_micros(self) -> int:
+        now = int(_time.monotonic() * 1e6)
+        self._last = max(self._last, now)
+        return self._last
+
+
+class LoopScheduler(api.Scheduler):
+    """Single-threaded timer heap driven by the serve loop (stdio) or the
+    Runner (in-process): `run_due()` fires expired timers, `next_deadline`
+    bounds the IO wait."""
+
+    class _Handle(api.Scheduler.Scheduled):
+        __slots__ = ("cancelled",)
+
+        def __init__(self):
+            self.cancelled = False
+
+        def cancel(self) -> None:
+            self.cancelled = True
+
+    def __init__(self, clock: WallClock):
+        self.clock = clock
+        self._heap: List = []
+        self._seq = itertools.count()
+
+    def once(self, delay_ms: float, fn: Callable[[], None]):
+        h = self._Handle()
+        heapq.heappush(self._heap, (self.clock.now_micros() + int(delay_ms * 1000),
+                                    next(self._seq), h, fn))
+        return h
+
+    def recurring(self, interval_ms: float, fn: Callable[[], None]):
+        h = self._Handle()
+
+        def tick():
+            if h.cancelled:
+                return
+            fn()
+            heapq.heappush(self._heap,
+                           (self.clock.now_micros() + int(interval_ms * 1000),
+                            next(self._seq), h, tick))
+
+        heapq.heappush(self._heap,
+                       (self.clock.now_micros() + int(interval_ms * 1000),
+                        next(self._seq), h, tick))
+        return h
+
+    def now(self, fn: Callable[[], None]) -> None:
+        fn()
+
+    def next_deadline_us(self) -> Optional[int]:
+        while self._heap and self._heap[0][2].cancelled:
+            heapq.heappop(self._heap)
+        return self._heap[0][0] if self._heap else None
+
+    def run_due(self) -> None:
+        now = self.clock.now_micros()
+        while self._heap and self._heap[0][0] <= now:
+            _, _, h, fn = heapq.heappop(self._heap)
+            if not h.cancelled:
+                fn()
+
+
+class _StaticConfigService(api.ConfigurationService):
+    def __init__(self, topology: Topology):
+        self._topology = topology
+
+    def current_topology(self) -> Topology:
+        return self._topology
+
+    def get_topology_for_epoch(self, epoch: int) -> Optional[Topology]:
+        return self._topology if epoch == self._topology.epoch else None
+
+
+class _StderrAgent(api.Agent):
+    def __init__(self, log: Callable[[str], None]):
+        self._log = log
+
+    def on_uncaught_exception(self, failure: BaseException) -> None:
+        self._log(f"uncaught: {failure!r}")
+
+    def on_inconsistent_timestamp(self, command, prev, next_ts) -> None:
+        self._log(f"inconsistent timestamp for {command}: {prev} vs {next_ts}")
+
+    def pre_accept_timeout_ms(self) -> float:
+        return 5000.0
+
+
+class _Transport(api.MessageSink):
+    """Accord messages over Maelstrom packets, with reply demux + timeouts."""
+
+    def __init__(self, mnode: "MaelstromNode"):
+        self.mnode = mnode
+        self._msg_ids = itertools.count(1)
+        self._pending: Dict[int, Tuple[object, object]] = {}
+
+    def send(self, to: int, request) -> None:
+        self._send(to, request, None)
+
+    def send_with_callback(self, to: int, request, callback) -> None:
+        self._send(to, request, callback)
+
+    def _send(self, to: int, request, callback) -> None:
+        mid = next(self._msg_ids)
+        if callback is not None:
+            handle = self.mnode.scheduler.once(
+                self.mnode.rpc_timeout_ms,
+                lambda: self._on_timeout(mid, to))
+            self._pending[mid] = (callback, handle)
+        body = {"type": "accord", "mid": mid,
+                "blob": base64.b64encode(wire.encode(request)).decode()}
+        if self.mnode.node is not None and to == self.mnode.node.id:
+            # Maelstrom does not loop a node's packets back to itself:
+            # deliver locally (still through the wire codec for isolation)
+            packet = {"src": self.mnode.maelstrom_id, "body": body}
+            self.mnode.scheduler.once(0.0, lambda: self.mnode.handle(packet))
+        else:
+            self.mnode.emit(f"n{to}", body)
+
+    def reply(self, to: int, reply_context, reply) -> None:
+        if reply is None:
+            return
+        origin, mid = reply_context
+        body = {"type": "accord_reply", "in_reply_to_mid": mid,
+                "blob": base64.b64encode(wire.encode(reply)).decode()}
+        if origin == self.mnode.maelstrom_id:
+            packet = {"src": origin, "body": body}
+            self.mnode.scheduler.once(0.0, lambda: self.mnode.handle(packet))
+        else:
+            self.mnode.emit(origin, body)
+
+    def on_reply_packet(self, src: str, body: dict) -> None:
+        entry = self._pending.pop(body["in_reply_to_mid"], None)
+        if entry is None:
+            return
+        callback, handle = entry
+        handle.cancel()
+        callback.on_success(_node_int(src), wire.decode(
+            base64.b64decode(body["blob"])))
+
+    def _on_timeout(self, mid: int, to: int) -> None:
+        entry = self._pending.pop(mid, None)
+        if entry is None:
+            return
+        callback, _ = entry
+        callback.on_failure(to, Timeout(f"no reply from n{to}"))
+
+
+def _node_int(maelstrom_id: str) -> int:
+    return int(maelstrom_id.lstrip("n")) if maelstrom_id.startswith("n") \
+        else -abs(hash(maelstrom_id)) % (1 << 15)
+
+
+def build_topology(node_ids: List[int], num_shards: int = 4,
+                   rf: Optional[int] = None) -> Topology:
+    nodes = sorted(node_ids)
+    rf = min(rf or 3, len(nodes))
+    width = KEY_DOMAIN // num_shards
+    shards = []
+    for i in range(num_shards):
+        start = i * width
+        end = KEY_DOMAIN if i == num_shards - 1 else (i + 1) * width
+        members = [nodes[(i + j) % len(nodes)] for j in range(rf)]
+        shards.append(Shard(Range(start, end), members))
+    return Topology(1, shards)
+
+
+class MaelstromNode:
+    """One Maelstrom process. `emit(dest, body)` is injected: stdio in
+    production, a router in the in-process Runner."""
+
+    def __init__(self, emit: Callable[[str, dict], None],
+                 log: Callable[[str], None] = lambda s: None,
+                 clock: Optional[WallClock] = None,
+                 scheduler: Optional[api.Scheduler] = None,
+                 rpc_timeout_ms: float = 3000.0):
+        self._emit_packet = emit
+        self.log = log
+        self.clock = clock or WallClock()
+        self.scheduler = scheduler or LoopScheduler(self.clock)
+        self.rpc_timeout_ms = rpc_timeout_ms
+        self.maelstrom_id: Optional[str] = None
+        self.node: Optional[Node] = None
+        self.transport = _Transport(self)
+        self._client_msg_ids = itertools.count(1)
+
+    # -- outbound -------------------------------------------------------------
+    def emit(self, dest: str, body: dict) -> None:
+        if "msg_id" not in body:
+            body["msg_id"] = next(self._client_msg_ids)
+        self._emit_packet(dest, body)
+
+    # -- inbound --------------------------------------------------------------
+    def handle(self, packet: dict) -> None:
+        body = packet.get("body", {})
+        kind = body.get("type")
+        src = packet.get("src", "")
+        try:
+            if kind == "init":
+                self._on_init(src, body)
+            elif kind == "txn":
+                self._on_txn(src, body)
+            elif kind == "accord":
+                mid = body["mid"]
+                request = wire.decode(base64.b64decode(body["blob"]))
+                self.node.receive(request, _node_int(src), (src, mid))
+            elif kind == "accord_reply":
+                self.transport.on_reply_packet(src, body)
+            else:
+                self.log(f"ignoring body type {kind!r}")
+        except BaseException as e:  # noqa: BLE001 -- a node must not die
+            self.log(f"error handling {kind}: {e!r}")
+            if kind == "txn":
+                self._error(src, body, 13, f"internal error: {e!r}")
+
+    def _on_init(self, src: str, body: dict) -> None:
+        self.maelstrom_id = body["node_id"]
+        my_id = _node_int(self.maelstrom_id)
+        peers = [_node_int(n) for n in body["node_ids"]]
+        topology = build_topology(peers)
+        from accord_tpu.impl.progress import ProgressEngine
+        engine = ProgressEngine(interval_ms=500.0, stall_ms=3000.0)
+        self.node = Node(
+            my_id,
+            message_sink=self.transport,
+            config_service=_StaticConfigService(topology),
+            scheduler=self.scheduler,
+            agent=_StderrAgent(self.log),
+            rng=RandomSource(my_id * 7919 + 17),
+            time_service=self.clock,
+            data_store=ListStore(),
+            num_stores=2,
+            progress_log_factory=engine.log_for,
+        )
+        engine.bind(self.node)
+        self.emit(src, {"type": "init_ok", "in_reply_to": body.get("msg_id")})
+
+    # -- the txn workload -----------------------------------------------------
+    def _on_txn(self, src: str, body: dict) -> None:
+        ops = body.get("txn", [])
+        read_keys: List[int] = []
+        appends: Dict[int, List[int]] = {}
+        for op, key, value in ops:
+            k = int(key) % KEY_DOMAIN
+            if op == "r":
+                read_keys.append(k)
+            elif op == "append":
+                if int(value) in appends.get(k, ()):
+                    # the storage layer dedupes identical (executeAt, value)
+                    # pairs for cross-replica idempotence, so an intra-txn
+                    # duplicate would be silently lost; Maelstrom's
+                    # list-append generator never produces one
+                    self._error(src, body, 10,
+                                f"duplicate append of {value} to key {key}")
+                    return
+                appends.setdefault(k, []).append(int(value))
+            else:
+                self._error(src, body, 10, f"unsupported op {op!r}")
+                return
+        all_keys = Keys(set(read_keys) | set(appends))
+        if len(all_keys) == 0:
+            self.emit(src, {"type": "txn_ok", "txn": ops,
+                            "in_reply_to": body.get("msg_id")})
+            return
+        update = MultiAppendUpdate({k: tuple(v) for k, v in appends.items()}) \
+            if appends else None
+        txn = Txn(TxnKind.WRITE if appends else TxnKind.READ, all_keys,
+                  read=ListRead(all_keys), update=update, query=ListQuery())
+
+        def done(result, failure):
+            if failure is not None:
+                self._error(src, body, 11, f"{type(failure).__name__}: {failure}")
+                return
+            out = []
+            appended_so_far: Dict[int, List[int]] = {}
+            for op, key, value in ops:
+                k = int(key) % KEY_DOMAIN
+                if op == "r":
+                    # Elle's list-append model expects intra-txn visibility:
+                    # a read after an append in the SAME txn includes it
+                    out.append([op, key, list(result.reads.get(k, ()))
+                                + appended_so_far.get(k, [])])
+                else:
+                    appended_so_far.setdefault(k, []).append(value)
+                    out.append([op, key, value])
+            self.emit(src, {"type": "txn_ok", "txn": out,
+                            "in_reply_to": body.get("msg_id")})
+
+        self.node.coordinate(txn).add_callback(done)
+
+    def _error(self, src: str, body: dict, code: int, text: str) -> None:
+        self.emit(src, {"type": "error", "code": code, "text": text,
+                        "in_reply_to": body.get("msg_id")})
