@@ -93,6 +93,11 @@ class ProgressEngine:
         entry = self.tracked.get(txn_id)
         if entry is not None and self._locally_resolved(entry):
             self.tracked.pop(txn_id, None)
+            if not self.tracked:
+                # going idle: one sweep so a stuck waiter missed by
+                # entry-level tracking still re-arms the tick loop
+                self._sweep_stuck_waiters()
+                self._ensure_scheduled()
 
     def _jitter(self) -> float:
         return self.rng.next_int(int(self.stall_ms)) / 2.0
@@ -105,6 +110,7 @@ class ProgressEngine:
 
     def _tick(self) -> None:
         self._scheduled = False
+        self._sweep_stuck_waiters()
         now = self.node.now_millis()
         for entry in list(self.tracked.values()):
             if self._locally_resolved(entry):
@@ -114,6 +120,35 @@ class ProgressEngine:
                 continue
             self._attempt(entry, now)
         self._ensure_scheduled()
+
+    def _sweep_stuck_waiters(self) -> None:
+        """Engine invariant: every command with pending wait edges on a
+        currently-owned range is tracked. Individual tracking can be lost to
+        clear()-time races (an entry judged resolved by one store's state
+        while another store's copy still waits); the sweep reinstates them so
+        the serial blocked-dep repair chain can never silently stop. Scans
+        only the per-store live-waiter index (maintained by commands.py),
+        not every command; stale index entries self-clean here."""
+        for store in self.node.command_stores.all():
+            for txn_id in list(store.live_waiters):
+                cmd = store.command_if_present(txn_id)
+                wo = cmd.waiting_on if cmd is not None else None
+                if cmd is None or wo is None or wo.is_done() \
+                        or cmd.status.is_terminal:
+                    store.live_waiters.discard(txn_id)
+                    continue
+                if txn_id in self.tracked:
+                    continue
+                participants = None
+                if cmd.route is not None:
+                    participants = cmd.route.participants
+                elif cmd.txn is not None:
+                    participants = cmd.txn.keys
+                if participants is None:
+                    continue
+                if not store.current_owned().intersects(participants):
+                    continue  # frozen leftover on a lost range
+                self.track(txn_id, participants, cmd.status)
 
     def _locally_resolved(self, entry: _Tracked) -> bool:
         """Done when every local store owning the participants has the command

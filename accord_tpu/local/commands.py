@@ -342,6 +342,8 @@ def _init_waiting_on(store: CommandStore, cmd: Command) -> None:
         else:
             wo.commit.add(dep_id)
             dep.add_waiter(cmd.txn_id)
+    if not wo.is_done():
+        store.live_waiters.add(cmd.txn_id)
 
 
 def maybe_execute(store: CommandStore, cmd: Command) -> None:
@@ -447,6 +449,7 @@ def _update_dependency(store: CommandStore, waiter: Command, dep: Command) -> No
         dep.remove_waiter(waiter.txn_id)
         changed = True
     if changed and wo.is_done():
+        store.live_waiters.discard(waiter.txn_id)
         # defer through the scheduler: a long chain of dependent commands
         # resolving at once must not recurse (apply A -> notify B -> apply B
         # -> ...); the reference gets this for free from per-store executors

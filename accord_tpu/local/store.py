@@ -51,6 +51,9 @@ class CommandStore:
         # reference: CommandStore.safeToRead)
         self.safe_to_read: Ranges = Ranges.EMPTY
         self.commands: Dict[TxnId, Command] = {}
+        # txn ids with live waiting_on edges (maintained by commands.py):
+        # the progress engine's stuck-waiter sweep scans only these
+        self.live_waiters: set = set()
         self.cfks: Dict[Key, CommandsForKey] = {}
         self.range_txns: Dict[TxnId, Ranges] = {}  # witnessed range-domain txns
         # max witnessed conflict per exact key (hot path: O(1) updates);

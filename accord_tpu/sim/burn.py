@@ -49,6 +49,7 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
              zipf_theta: float = 0.0,
              chaos_drop: float = 0.0, chaos_partitions: bool = False,
              topology_churn: bool = False, churn_interval_ms: float = 1000.0,
+             crash_restart: bool = False, crash_down_ms: float = 800.0,
              config: Optional[ClusterConfig] = None,
              collect_log: bool = False) -> BurnReport:
     cfg = config or ClusterConfig(num_nodes=nodes, rf=rf)
@@ -93,10 +94,24 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
             verifier.on_issue_write(value, start_us)
         attempt(txn, value, writes, start_us, retries=3)
 
+    down: set = set()      # crashed node ids (never used as coordinators)
+    inflight: Dict = {}    # token -> (coordinator_id, fail_fn): a crashed
+                           # coordinator's client callbacks die with it, so
+                           # the workload fails those attempts itself (the
+                           # real client's timeout)
+    tokens = iter(range(1 << 30))
+
     def attempt(txn, value, writes, start_us, retries):
-        node = cluster.nodes[1 + wl_rng.next_int(cfg.num_nodes)]
+        up = [n for n in range(1, cfg.num_nodes + 1) if n not in down]
+        node = cluster.nodes[wl_rng.pick(up)]
+        token = next(tokens)
+        done_flag = [False]
 
         def complete(result, failure):
+            if done_flag[0]:
+                return
+            done_flag[0] = True
+            inflight.pop(token, None)
             end_us = cluster.queue.now_micros
             if failure is None:
                 state["completed"] += 1
@@ -121,6 +136,7 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
             # keep the pipeline full
             cluster.queue.add(wl_rng.next_int(5_000), submit)
 
+        inflight[token] = (node.id, lambda f: complete(None, f))
         node.coordinate(txn).add_callback(complete)
 
     # chaos: periodically re-randomize link behavior (drops, partitions) the
@@ -166,6 +182,47 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
                            interval_us=int(churn_interval_ms * 1000),
                            should_stop=lambda: state["completed"] >= ops).start()
 
+    # crash/restart: kill each node once (staggered, one at a time so every
+    # quorum survives), replay its journal on restart and diff the rebuilt
+    # command state against the pre-crash snapshot (reference: Journal +
+    # pseudo-restart, test impl/basic/Journal.java:59)
+    if crash_restart:
+        crash_rng = cluster.rng.fork()
+
+        def schedule_crash(nid: int, at_us: int):
+            def crash():
+                if state["completed"] >= ops:
+                    return  # workload done
+                if down:
+                    # another node is still down/recovering: defer rather
+                    # than silently skip this node's crash
+                    cluster.queue.add(int(crash_down_ms * 1000 * 2), crash)
+                    return
+                down.add(nid)
+                snapshot = cluster.crash_node(nid)
+                from accord_tpu.coordinate.errors import Timeout as _T
+                for token, (coord, fail) in list(inflight.items()):
+                    if coord == nid:
+                        fail(_T(f"coordinator n{nid} crashed"))
+
+                def restart():
+                    ready_us = cluster.restart_node(nid)
+
+                    def verify():
+                        down.discard(nid)
+                        cluster.verify_rebuild(nid, snapshot)
+
+                    # anchor on replay+catch-up completion, not a fixed lag
+                    cluster.queue.add(ready_us + 1_500_000, verify)
+
+                cluster.queue.add(int(crash_down_ms * 1000), restart)
+
+            cluster.queue.add(at_us, crash)
+
+        for i, nid in enumerate(sorted(cluster.nodes)):
+            schedule_crash(nid, 1_500_000 + i * int(crash_down_ms * 1000 * 4)
+                           + crash_rng.next_int(500_000))
+
     if cfg.durability:
         cluster.start_durability(
             should_stop=lambda: state["completed"] >= ops)
@@ -208,6 +265,8 @@ def main(argv=None) -> int:
     ap.add_argument("--topology-churn", action="store_true",
                     help="randomly split/merge/move shards during the burn")
     ap.add_argument("--churn-interval-ms", type=float, default=1000.0)
+    ap.add_argument("--crash-restart", action="store_true",
+                    help="crash+restart each node once (journal replay)")
     ap.add_argument("--reconcile", action="store_true",
                     help="run each seed twice; require identical logs")
     args = ap.parse_args(argv)
@@ -219,7 +278,8 @@ def main(argv=None) -> int:
                       chaos_drop=args.chaos_drop,
                       chaos_partitions=args.chaos_partitions,
                       topology_churn=args.topology_churn,
-                      churn_interval_ms=args.churn_interval_ms)
+                      churn_interval_ms=args.churn_interval_ms,
+                      crash_restart=args.crash_restart)
         try:
             r = run_burn(seed, collect_log=args.reconcile, **kwargs)
             if args.reconcile:

@@ -16,6 +16,7 @@ from accord_tpu.primitives.timestamp import NodeId
 from accord_tpu.sim.list_store import ListStore
 from accord_tpu.sim.network import SimNetwork
 from accord_tpu.sim.queue import PendingQueue
+from accord_tpu.sim import wire
 from accord_tpu.sim.scheduler import SimScheduler, SimTimeService
 from accord_tpu.topology.shard import Shard
 from accord_tpu.topology.topology import Topology
@@ -92,6 +93,12 @@ class SimTopologyService:
 
     def mark_initial(self, node_id: NodeId) -> None:
         self._delivered[node_id] = 1
+
+    def reset_delivery(self, node_id: NodeId) -> None:
+        """A restarted node re-learns the whole epoch history from scratch
+        (its construction reads epoch 1, then _pump walks it forward)."""
+        self._delivered[node_id] = 1
+        self._delivering.discard(node_id)
 
     def issue(self, topology: Topology) -> None:
         assert topology.epoch == max(self.epochs) + 1, \
@@ -173,46 +180,154 @@ class Cluster:
         self.stores: Dict[NodeId, ListStore] = {}
         self.progress_engines: Dict[NodeId, object] = {}
         self.topology_service = SimTopologyService(self, self.topology)
+        # crash/restart machinery (reference: test Journal + pseudo-restart):
+        # per-node liveness cells (kill ghost timers), per-node constructor
+        # closures, and a journal of delivered side-effect requests
+        self._alive: Dict[NodeId, list] = {}
+        self._node_rngs: Dict[NodeId, RandomSource] = {}
+        self.journals: Dict[NodeId, List] = {}
+        self.network.on_deliver = self._journal_record
         for node_id in range(1, self.config.num_nodes + 1):
-            store = ListStore()
-            progress_factory = None
-            engine = None
-            if self.config.progress:
-                from accord_tpu.impl.progress import ProgressEngine
-                engine = ProgressEngine(
-                    interval_ms=self.config.progress_interval_ms,
-                    stall_ms=self.config.progress_stall_ms)
-                progress_factory = engine.log_for
+            self.stores[node_id] = ListStore()
+            self.journals[node_id] = []
+            self._node_rngs[node_id] = self.rng.fork()
             self.topology_service.mark_initial(node_id)
-            node = Node(
-                node_id,
-                message_sink=self.network.sink_for(node_id),
-                config_service=SimConfigService(self.topology_service, node_id),
-                scheduler=self.scheduler,
-                agent=SimAgent(self, node_id),
-                rng=self.rng.fork(),
-                time_service=self.time_service,
-                data_store=store,
-                num_stores=self.config.stores_per_node,
-                progress_log_factory=progress_factory,
-                deps_resolver=(self.config.deps_resolver_factory()
-                               if self.config.deps_resolver_factory else None),
-                deps_batch_window_ms=self.config.deps_batch_window_ms,
-                device_latency_ms=self.config.device_latency_ms,
-            )
-            if engine is not None:
-                engine.bind(node)
-                self.progress_engines[node_id] = engine
-            self.nodes[node_id] = node
-            self.stores[node_id] = store
-            self.network.register_node(node)
+            self._build_node(node_id)
         self.durability_schedulers = []
+        self._durability_should_stop = None
+
+    def _journal_record(self, dst: NodeId, src: NodeId, payload: bytes) -> None:
+        self.journals[dst].append((src, payload))
+
+    def _build_node(self, node_id: NodeId) -> Node:
+        from accord_tpu.sim.scheduler import NodeScheduler
+        alive = [True]
+        self._alive[node_id] = alive
+        progress_factory = None
+        engine = None
+        if self.config.progress:
+            from accord_tpu.impl.progress import ProgressEngine
+            engine = ProgressEngine(
+                interval_ms=self.config.progress_interval_ms,
+                stall_ms=self.config.progress_stall_ms)
+            progress_factory = engine.log_for
+        node = Node(
+            node_id,
+            message_sink=self.network.sink_for(node_id),
+            config_service=SimConfigService(self.topology_service, node_id),
+            scheduler=NodeScheduler(self.queue, alive),
+            agent=SimAgent(self, node_id),
+            rng=self._node_rngs[node_id].fork(),
+            time_service=self.time_service,
+            data_store=self.stores[node_id],
+            num_stores=self.config.stores_per_node,
+            progress_log_factory=progress_factory,
+            deps_resolver=(self.config.deps_resolver_factory()
+                           if self.config.deps_resolver_factory else None),
+            deps_batch_window_ms=self.config.deps_batch_window_ms,
+            device_latency_ms=self.config.device_latency_ms,
+        )
+        if engine is not None:
+            engine.bind(node)
+            self.progress_engines[node_id] = engine
+        self.nodes[node_id] = node
+        self.network.register_node(node)
+        return node
+
+    # -- crash / restart ------------------------------------------------------
+    def crash_node(self, node_id: NodeId) -> dict:
+        """Kill a node: its timers stop re-arming, its sends and deliveries
+        are muted, in-flight messages to it are lost, and its registered
+        reply callbacks are purged (a late timeout must not resurrect the
+        dead incarnation's coordinations once the node restarts). Returns a
+        snapshot of its stable+ command state for the rebuild diff."""
+        snapshot = self.stable_snapshot(node_id)
+        self._alive[node_id][0] = False
+        self.network.dead.add(node_id)
+        self.network.purge_callbacks_of(node_id)
+        return snapshot
+
+    def restart_node(self, node_id: NodeId) -> int:
+        """Bring the node back as a FRESH process: empty command state, the
+        (durable) data store retained, topology re-learned from epoch 1, and
+        the journal of side-effect messages replayed -- exactly a restart's
+        recovery path. Replayed requests' replies address long-gone message
+        ids and are dropped by the reply demux. Returns the sim-microsecond
+        delay (from now) after which the replay AND catch-up fetch have been
+        issued -- callers anchor rebuild checks on it."""
+        from accord_tpu.sim.network import ReplyContext
+        self.topology_service.reset_delivery(node_id)
+        self.network.dead.discard(node_id)
+        node = self._build_node(node_id)
+        self.topology_service.request(node_id)  # re-pump epochs 2..latest
+        replay_rng = self._node_rngs[node_id].fork()
+        delay = 1_000
+        for (src, payload) in list(self.journals[node_id]):
+            # spread the replay over a little sim time, preserving order
+            delay += 50 + replay_rng.next_int(50)
+            self.queue.add(delay, lambda s=src, p=payload: node.receive(
+                wire.decode(p), s, ReplyContext(s, -1)))
+
+        def catch_up():
+            # writes applied by the cluster WHILE this node was down were
+            # never journaled here (its disk missed them): after the replay
+            # settles, refresh every store's currently-owned ranges with a
+            # bootstrap fetch from peers -- the standard restart catch-up
+            # sync (reference: markShardStale -> Bootstrap re-acquisition)
+            from accord_tpu.local.bootstrap import Bootstrap
+            for s in node.command_stores.all():
+                owned = s.current_owned()
+                if not owned.is_empty():
+                    Bootstrap.run(node, s, max(2, node.epoch), owned)
+
+        self.queue.add(delay + 200_000, catch_up)
+        if self._durability_should_stop is not None:
+            # the rotation died with the old incarnation's scheduler:
+            # restart it for the new one
+            from accord_tpu.impl.durability import DurabilityScheduling
+            sched = DurabilityScheduling(
+                node, interval_ms=self.config.durability_interval_ms,
+                should_stop=self._durability_should_stop)
+            sched.start()
+            self.durability_schedulers.append(sched)
+        return delay + 200_000
+
+    def stable_snapshot(self, node_id: NodeId) -> dict:
+        """(store_id, txn_id) -> (status, execute_at) for stable+ commands:
+        what a journal replay must reconstruct (reference: Journal's
+        reflection diff of rebuilt commands)."""
+        from accord_tpu.local.status import Status
+        out = {}
+        for s in self.nodes[node_id].command_stores.all():
+            for txn_id, cmd in s.commands.items():
+                if cmd.status.is_stable:
+                    out[(s.store_id, txn_id)] = (cmd.status, cmd.execute_at)
+        return out
+
+    def verify_rebuild(self, node_id: NodeId, snapshot: dict) -> None:
+        """Every stable+ command of the pre-crash snapshot must be rebuilt
+        with the SAME executeAt and at least stable status (or have been
+        legitimately finished as terminal by floors that advanced since)."""
+        stores = {s.store_id: s for s in self.nodes[node_id].command_stores.all()}
+        for (store_id, txn_id), (status, execute_at) in snapshot.items():
+            s = stores[store_id]
+            cmd = s.command_if_present(txn_id)
+            if cmd is None or cmd.status.is_terminal:
+                ok = s.is_truncated(txn_id, s.ranges) or (
+                    cmd is not None and cmd.status.is_terminal)
+                assert ok, f"store {store_id}: {txn_id} lost in rebuild"
+                continue
+            assert cmd.status.is_stable, \
+                f"store {store_id}: {txn_id} rebuilt only to {cmd.status.name}"
+            assert cmd.execute_at == execute_at, \
+                f"store {store_id}: {txn_id} executeAt {cmd.execute_at} != {execute_at}"
 
     def start_durability(self, should_stop=None) -> None:
         """Start background durability rotation on every node. The caller
         supplies should_stop so a simulated run can quiesce (a recurring task
         with no stop condition would keep the event queue alive forever)."""
         from accord_tpu.impl.durability import DurabilityScheduling
+        self._durability_should_stop = should_stop or (lambda: False)
         for node in self.nodes.values():
             sched = DurabilityScheduling(
                 node, interval_ms=self.config.durability_interval_ms,

@@ -56,6 +56,11 @@ class SimNetwork:
         self._default_link = LinkConfig()
         self._links: Dict[Tuple[NodeId, NodeId], LinkConfig] = {}
         self.partitioned: set = set()  # set of frozenset({a, b}) pairs cut off
+        self.dead: set = set()         # crashed nodes: sends and deliveries muted
+        # journal hook: (dst, src, payload_bytes, request) for every
+        # side-effect-bearing request actually delivered (crash/restart
+        # rebuilds command state by replaying these; reference: Journal)
+        self.on_deliver = None
         self.stats: Dict[str, int] = {"sent": 0, "delivered": 0, "dropped": 0,
                                       "timeouts": 0, "replies": 0}
 
@@ -94,13 +99,15 @@ class SimNetwork:
 
     def send_request(self, src: NodeId, dst: NodeId, request,
                      callback: Optional[Callback]) -> None:
+        if src in self.dead:
+            return  # a crashed incarnation's residual sends are muted
         self.stats["sent"] += 1
         msg_id = next(self._msg_ids)
         if callback is not None:
             timeout_handle = self.queue.add(
                 int(self.timeout_ms * 1000),
                 lambda: self._on_timeout(msg_id, dst))
-            self._pending[msg_id] = (callback, timeout_handle)
+            self._pending[msg_id] = (callback, timeout_handle, src)
         if self._should_drop(src, dst):
             self.stats["dropped"] += 1
             return
@@ -108,21 +115,29 @@ class SimNetwork:
         # the send, and must never share live state with the sender
         payload = wire.encode(request) if self.serialize and src != dst else None
         ctx = ReplyContext(src, msg_id)
-        node = self.nodes.get(dst)
-        if node is None:
-            # destination down/not yet joined: behaves like a drop (the
-            # sender's timeout fires)
-            self.stats["dropped"] += 1
-            return
 
         def deliver():
+            node = self.nodes.get(dst)
+            if node is None or dst in self.dead:
+                # destination down: behaves like a drop (sender's timeout
+                # fires). Resolved at DELIVERY time so a crash between send
+                # and arrival loses the message, as it should.
+                self.stats["dropped"] += 1
+                return
             self._count("delivered")
+            if self.on_deliver is not None \
+                    and getattr(request, "has_side_effects", True):
+                self.on_deliver(dst, src,
+                                payload if payload is not None
+                                else wire.encode(request))
             msg = wire.decode(payload) if payload is not None else request
             node.receive(msg, src, ctx)
 
         self.queue.add(self._latency(src, dst), deliver)
 
     def send_reply(self, src: NodeId, ctx: ReplyContext, reply) -> None:
+        if src in self.dead:
+            return
         self.stats["replies"] += 1
         if self._should_drop(src, ctx.origin):
             self.stats["dropped"] += 1
@@ -132,10 +147,12 @@ class SimNetwork:
                        lambda: self._deliver_reply(src, ctx, reply, payload))
 
     def _deliver_reply(self, src: NodeId, ctx: ReplyContext, reply, payload=None) -> None:
+        if ctx.origin in self.dead:
+            return  # the requester crashed; its callbacks died with it
         entry = self._pending.pop(ctx.msg_id, None)
         if entry is None:
             return  # no callback registered or already timed out
-        callback, timeout_handle = entry
+        callback, timeout_handle, _ = entry
         timeout_handle.cancel()
         callback.on_success(src, wire.decode(payload) if payload is not None else reply)
 
@@ -143,9 +160,21 @@ class SimNetwork:
         entry = self._pending.pop(msg_id, None)
         if entry is None:
             return
+        callback, _, origin = entry
+        if origin in self.dead:
+            return  # a dead incarnation's callback must never fire
         self.stats["timeouts"] += 1
-        callback, _ = entry
         callback.on_failure(dst, Timeout(f"no reply from {dst}"))
+
+    def purge_callbacks_of(self, origin: NodeId) -> None:
+        """Drop every pending callback registered by `origin`'s CURRENT
+        incarnation -- a restarted node must not have its predecessor's
+        coordinations resurrected by late replies or timeouts firing after
+        the dead flag is lifted."""
+        stale = [mid for mid, (_, _, o) in self._pending.items() if o == origin]
+        for mid in stale:
+            _, handle, _ = self._pending.pop(mid)
+            handle.cancel()
 
     def _count(self, key: str) -> None:
         self.stats[key] += 1

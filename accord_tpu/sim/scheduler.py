@@ -35,6 +35,46 @@ class SimScheduler(Scheduler):
         fn()
 
 
+class NodeScheduler(SimScheduler):
+    """Per-node facade with a kill switch: after a crash, the dead
+    incarnation's timers (progress ticks, batch ticks, retries) must neither
+    run nor re-arm -- a ghost node scheduling forever would both act on the
+    cluster and prevent quiescence."""
+
+    def __init__(self, queue: PendingQueue, alive: list):
+        super().__init__(queue)
+        self.alive = alive  # single-element cell, flipped False on crash
+
+    def _guard(self, fn: Callable[[], None]) -> Callable[[], None]:
+        cell = self.alive
+
+        def run():
+            if cell[0]:
+                fn()
+
+        return run
+
+    def once(self, delay_ms: float, fn: Callable[[], None]) -> Cancellable:
+        return super().once(delay_ms, self._guard(fn))
+
+    def recurring(self, interval_ms: float, fn: Callable[[], None]) -> Cancellable:
+        handle = Cancellable()
+        cell = self.alive
+
+        def tick():
+            if handle.cancelled or not cell[0]:
+                return  # dead: neither run nor RE-ARM
+            fn()
+            self.queue.add(int(interval_ms * 1000), tick)
+
+        self.queue.add(int(interval_ms * 1000), tick)
+        return handle
+
+    def now(self, fn: Callable[[], None]) -> None:
+        if self.alive[0]:
+            fn()
+
+
 class SimTimeService(TimeService):
     def __init__(self, queue: PendingQueue):
         self.queue = queue
