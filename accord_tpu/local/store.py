@@ -56,6 +56,11 @@ class CommandStore:
         self.live_waiters: set = set()
         self.cfks: Dict[Key, CommandsForKey] = {}
         self.range_txns: Dict[TxnId, Ranges] = {}  # witnessed range-domain txns
+        # interval index over range_txns (reference: SearchableRangeList /
+        # CINTIA, utils/SearchableRangeList.java) -- stab/overlap queries
+        # instead of linear scans
+        from accord_tpu.utils.interval_index import IntervalIndex
+        self.range_index = IntervalIndex()
         # max witnessed conflict per exact key (hot path: O(1) updates);
         # range-domain txns land in the range map (rare, merged on query)
         self.max_conflicts_by_key: Dict[Key, Timestamp] = {}
@@ -443,6 +448,7 @@ class CommandStore:
                         if c.is_empty():
                             del self.cfks[k]
         self.range_txns.pop(txn_id, None)
+        self.range_index.remove(txn_id)
         if self.deps_resolver is not None:
             self.deps_resolver.on_truncate(self, txn_id)
 
@@ -668,9 +674,8 @@ class CommandStore:
         kind = txn_id.kind
         Invariants.check_argument(isinstance(seekables, Keys))
         for k in self.owned_keys(seekables):
-            for rid, rranges in self.range_txns.items():
-                if rid != txn_id and rid < before and kind.witnesses(rid.kind) \
-                        and rranges.contains_key(k):
+            for rid in self.range_index.stab(int(k)):
+                if rid != txn_id and rid < before and kind.witnesses(rid.kind):
                     kb.add(k, rid)
         return Deps(kb.build())
 
@@ -695,11 +700,13 @@ class CommandStore:
                 if owned.contains_key(k):
                     for dep in c.conflicts_before(txn_id, before):
                         rb.add(Range.point(k), dep)
-            # other range txns
-            for rid, rranges in self.range_txns.items():
+            # other range txns: candidates via the interval index
+            candidates = set()
+            for r in owned:
+                candidates.update(self.range_index.over(r.start, r.end))
+            for rid in candidates:
                 if rid != txn_id and rid < before and kind.witnesses(rid.kind):
-                    inter = rranges.intersection(owned)
-                    for r in inter:
+                    for r in self.range_txns[rid].intersection(owned):
                         rb.add(r, rid)
         return Deps(kb.build(), rb.build())
 
@@ -729,9 +736,7 @@ class CommandStore:
             c = self.cfks.get(k)
             if c is not None:
                 yield from c._infos.keys()
-            for rid, rranges in self.range_txns.items():
-                if rranges.contains_key(k):
-                    yield rid
+            yield from self.range_index.stab(int(k))
 
         if isinstance(seekables, Keys):
             owned_keys = self.owned_keys(seekables)
@@ -776,9 +781,14 @@ class CommandStore:
         else:
             if status == CfkStatus.INVALIDATED:
                 self.range_txns.pop(txn_id, None)
+                self.range_index.remove(txn_id)
             else:
                 prev = self.range_txns.get(txn_id)
-                self.range_txns[txn_id] = prev.union(owned) if prev else owned
+                merged = prev.union(owned) if prev else owned
+                self.range_txns[txn_id] = merged
+                self.range_index.remove(txn_id)
+                for r in merged:
+                    self.range_index.add(txn_id, r.start, r.end)
         self.update_max_conflicts(owned, witnessed_at)
         if self.deps_resolver is not None:
             # incremental device active-set maintenance (append/lane update,

@@ -234,6 +234,11 @@ class Ranges:
     def __len__(self) -> int:
         return len(self._ranges)
 
+    def to_ranges(self) -> "Ranges":
+        """Uniform Seekables surface (Keys.to_ranges converts; Ranges is
+        already ranges)."""
+        return self
+
     def __getitem__(self, i: int) -> Range:
         return self._ranges[i]
 

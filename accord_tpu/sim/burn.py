@@ -19,12 +19,14 @@ import sys
 from typing import Dict, List, Optional, Tuple
 
 from accord_tpu.coordinate.errors import Invalidated
-from accord_tpu.primitives.keyspace import Keys
+from accord_tpu.primitives.keyspace import Keys, Range, Ranges
 from accord_tpu.primitives.timestamp import Domain, TxnKind
 from accord_tpu.primitives.txn import Txn
 from accord_tpu.sim.cluster import Cluster, ClusterConfig
 from accord_tpu.sim.network import LinkConfig
-from accord_tpu.sim.list_store import ListQuery, ListRead, ListResult, ListUpdate
+from accord_tpu.sim.list_store import (
+    ListQuery, ListRangeRead, ListRead, ListResult, ListUpdate,
+)
 from accord_tpu.sim.verifier import StrictSerializabilityVerifier
 from accord_tpu.utils.rng import RandomSource
 
@@ -50,6 +52,7 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
              chaos_drop: float = 0.0, chaos_partitions: bool = False,
              topology_churn: bool = False, churn_interval_ms: float = 1000.0,
              crash_restart: bool = False, crash_down_ms: float = 800.0,
+             range_read_ratio: float = 0.0, max_range_width: int = 2048,
              config: Optional[ClusterConfig] = None,
              collect_log: bool = False) -> BurnReport:
     cfg = config or ClusterConfig(num_nodes=nodes, rf=rf)
@@ -71,6 +74,17 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
             return wl_rng.pick(key_space)
 
     def gen_txn() -> Tuple[Txn, Optional[int], Dict]:
+        if range_read_ratio > 0.0 and wl_rng.decide(range_read_ratio):
+            # range-domain READ over an interval of the hash domain
+            # (reference burn generates range reads, BurnTest.java:123)
+            anchor = pick_key()
+            width = 1 + wl_rng.next_int(max_range_width)
+            start = max(0, anchor - wl_rng.next_int(width))
+            end = min(cfg.key_domain, start + width)
+            ranges = Ranges([Range(start, max(end, start + 1))])
+            txn = Txn(TxnKind.READ, ranges, read=ListRangeRead(ranges),
+                      query=ListQuery())
+            return txn, None, {}
         nkeys = wl_rng.next_int_between(1, max_keys_per_txn + 1)
         chosen = Keys(pick_key() for _ in range(nkeys))
         is_write = wl_rng.decide(write_ratio)

@@ -89,6 +89,31 @@ class ListRead(api.Read):
         return ListRead(self._keys.union(other._keys))
 
 
+class ListRangeRead(api.Read):
+    """Range-domain read: returns every key's list within the ranges as of
+    executeAt (the reference burn's range reads, BurnTest.java:123)."""
+
+    def __init__(self, ranges: Ranges):
+        self._ranges = ranges
+
+    def keys(self) -> Ranges:
+        return self._ranges
+
+    def read(self, rng, store, execute_at: Timestamp) -> Optional[ListData]:
+        data_store: ListStore = store.node.data_store
+        out = {}
+        for key in data_store.data:
+            if rng.contains(key):
+                out[key] = data_store.read_at(key, execute_at)
+        return ListData(out)
+
+    def slice(self, ranges: Ranges) -> "ListRangeRead":
+        return ListRangeRead(self._ranges.intersection(ranges))
+
+    def merge(self, other: "ListRangeRead") -> "ListRangeRead":
+        return ListRangeRead(self._ranges.union(other._ranges))
+
+
 class ListWrite(api.Write):
     def __init__(self, appends: Dict[object, int]):
         self.appends = appends
@@ -136,8 +161,10 @@ class ListQuery(api.Query):
     def compute(self, txn_id: TxnId, execute_at: Timestamp, keys, data,
                 read, update) -> ListResult:
         reads = dict(data.entries) if data is not None else {}
-        # ensure every read key reports (possibly-empty) observations
-        if read is not None:
+        # ensure every read KEY reports (possibly-empty) observations; a
+        # range read's observations are whatever keys the scan found (a
+        # Range itself is not a reads-dict key)
+        if read is not None and isinstance(read.keys(), Keys):
             for k in read.keys():
                 reads.setdefault(k, ())
         return ListResult(txn_id, execute_at, reads,
