@@ -74,3 +74,106 @@ def test_rejects_final_divergence():
     v.witness(40, 45, {"k": (1, 2)}, {})
     with pytest.raises(HistoryViolation, match="diverges|shorter"):
         v.check_final_state({"k": (2, 1)})
+
+
+def test_rejects_cross_key_cycle():
+    # classic G-single shape: two concurrent readers observe opposite
+    # orderings of two independent writes -- per-key prefixes are fine, only
+    # the cross-key happens-before closure can catch it (the reference's
+    # max-predecessor graph, verify/StrictSerializabilityVerifier.java:58)
+    v = StrictSerializabilityVerifier()
+    v.on_issue_write(1, 5)
+    v.on_issue_write(2, 5)
+    v.witness(10, 90, {"a": (1,), "b": ()}, {})
+    with pytest.raises(HistoryViolation, match="cycle"):
+        v.witness(11, 91, {"b": (2,), "a": ()}, {})
+
+
+def test_accepts_concurrent_consistent_snapshots():
+    v = StrictSerializabilityVerifier()
+    v.on_issue_write(1, 5)
+    v.on_issue_write(2, 5)
+    v.witness(10, 90, {"a": (1,), "b": ()}, {})
+    v.witness(11, 91, {"a": (1,), "b": ()}, {})
+    v.witness(12, 92, {"a": (1,), "b": (2,)}, {})
+    v.witness(13, 93, {"a": (), "b": ()}, {})  # concurrent: may be behind
+    v.check_final_state({"a": (1,), "b": (2,)})
+
+
+def test_rejects_mutual_write_visibility():
+    # T observes U's write and U observes T's write: not serializable even
+    # though each key's order alone is consistent
+    v = StrictSerializabilityVerifier()
+    v.on_issue_write(1, 5)
+    v.on_issue_write(2, 5)
+    v.witness(10, 90, {"a": (), "b": (2,)}, {"a": 1})
+    with pytest.raises(HistoryViolation, match="cycle"):
+        v.witness(11, 91, {"b": (), "a": (1,)}, {"b": 2})
+
+
+def test_accepts_multikey_writes():
+    v = StrictSerializabilityVerifier()
+    for val in (1, 2, 3):
+        v.on_issue_write(val, 5)
+    v.witness(10, 20, {"a": (), "b": ()}, {"a": 1, "b": 1})
+    v.witness(30, 40, {"a": (1,), "b": (1,)}, {"a": 2, "b": 2})
+    v.witness(50, 60, {"a": (1, 2), "b": (1, 2)}, {})
+    v.check_final_state({"a": (1, 2), "b": (1, 2)})
+
+
+def test_rejects_interleaved_multikey_writes():
+    # two concurrent multi-key writes that land in OPPOSITE orders on the
+    # two keys: per-key orders are fine, the interleaving is not
+    v = StrictSerializabilityVerifier()
+    v.on_issue_write(1, 5)
+    v.on_issue_write(2, 5)
+    v.witness(10, 90, {"a": (), "b": (2,)}, {"a": 1, "b": 1})
+    with pytest.raises(HistoryViolation, match="cycle"):
+        v.witness(11, 91, {"a": (1,), "b": ()}, {"a": 2, "b": 2})
+
+
+def test_blind_write_position_resolved_via_later_read():
+    v = StrictSerializabilityVerifier()
+    v.on_issue_write(1, 5)
+    v.on_issue_write(2, 6)
+    v.witness(10, 20, {}, {"a": 1})        # blind write: position deferred
+    v.witness(30, 40, {"a": (1,)}, {})     # resolves it to index 0
+    v.check_final_state({"a": (1,)})
+
+
+def test_blind_write_resolved_at_final_state():
+    v = StrictSerializabilityVerifier()
+    v.on_issue_write(1, 5)
+    v.witness(10, 20, {}, {"a": 1})
+    v.check_final_state({"a": (1,)})
+
+
+def test_rejects_duplicate_position_claim():
+    # lost update: two concurrent writers both read () so both claim list
+    # index 0 -- impossible in any serial order
+    v = StrictSerializabilityVerifier()
+    v.on_issue_write(1, 5)
+    v.on_issue_write(2, 5)
+    v.witness(10, 90, {"a": ()}, {"a": 1})
+    with pytest.raises(HistoryViolation, match="both claim"):
+        v.witness(11, 91, {"a": ()}, {"a": 2})
+
+
+def test_rejects_claim_contradicting_order():
+    # writer read () claiming index 0, but the observed order puts its value
+    # at index 1
+    v = StrictSerializabilityVerifier()
+    v.on_issue_write(1, 5)
+    v.on_issue_write(2, 5)
+    v.witness(10, 90, {"a": ()}, {"a": 2})
+    with pytest.raises(HistoryViolation, match="claim"):
+        v.witness(50, 95, {"a": (1, 2)}, {})
+
+
+def test_blind_write_resolved_immediately_if_already_observed():
+    v = StrictSerializabilityVerifier()
+    v.on_issue_write(1, 5)
+    v.witness(10, 20, {"a": (1,)}, {})   # reader observes value first
+    v.witness(11, 30, {}, {"a": 1})      # blind writer witnessed later
+    assert not v._pending                # resolved at witness time
+    v.check_final_state({"a": (1,)})
