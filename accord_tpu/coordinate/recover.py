@@ -433,8 +433,11 @@ def propose_invalidate(node, txn_id: TxnId, ballot: Ballot, key,
         # flight races a late WitnessedElsewhere abort against an accepted
         # invalidation quorum — the caller would be told "recover instead"
         # after we wrote the very state that makes recovery finish the kill.
-        answered = 0
-        quorum = False
+        def __init__(self):
+            self.answered = 0
+            self.quorum = False
+            self.promised_clean: set = set()   # replied, no prior fast vote
+            self.witnesses: list = []          # (node, status, fast_vote)
 
         def on_success(self, from_node, reply) -> None:
             if result.done:
@@ -449,12 +452,21 @@ def propose_invalidate(node, txn_id: TxnId, ballot: Ballot, key,
                 result.try_set_failure(Preempted(
                     f"invalidate {txn_id}: already decided ({reply.status.name})"))
                 return
-            if reply.status.has_been(Status.PRE_ACCEPTED) \
-                    and reply.status != Status.ACCEPTED_INVALIDATE \
-                    and not reply.status.is_terminal:
+            if reply.status == Status.ACCEPTED:
+                # an accepted slow-path proposal exists: recovery must resume
+                # it, not kill it
                 result.try_set_failure(
                     WitnessedElsewhere(txn_id, reply.status, reply.route))
                 return
+            if reply.status.has_been(Status.PRE_ACCEPTED) \
+                    and reply.status != Status.ACCEPTED_INVALIDATE \
+                    and not reply.status.is_terminal:
+                # witnessed but undecided: defer the verdict to the
+                # electorate analysis once everyone reachable has answered
+                self.witnesses.append(
+                    (from_node, reply.status, reply.route))
+            if not reply.fast_path_vote:
+                self.promised_clean.add(from_node)
             if prepare_tracker.on_success(from_node) == RequestStatus.SUCCESS:
                 self.quorum = True
             self._maybe_dispatch()
@@ -469,8 +481,29 @@ def propose_invalidate(node, txn_id: TxnId, ballot: Ballot, key,
             self._maybe_dispatch()
 
         def _maybe_dispatch(self) -> None:
-            if self.answered >= len(shard.nodes) and self.quorum:
-                accept_round()
+            if self.answered < len(shard.nodes) or not self.quorum:
+                return
+            if self.witnesses:
+                # Witnessed-but-undecided replies do NOT force recovery when
+                # the fast path is decisively dead (reference:
+                # Invalidate.java:161 isSafeToInvalidate): our promises block
+                # any FUTURE ballot-0 vote (preaccept is ballot-gated), so
+                # the only possible fast voters are those who already voted
+                # plus electorate members we could not reach. The slow path
+                # is blocked by quorum intersection with our promises. If a
+                # fast quorum is still arithmetically possible, fall back to
+                # recovery.
+                potential = [n for n in shard.fast_path_electorate
+                             if n not in self.promised_clean]
+                if len(potential) >= shard.fast_path_quorum_size:
+                    _, status, route = max(self.witnesses, key=lambda w: w[1])
+                    if route is None:
+                        route = next((r for _, _, r in self.witnesses
+                                      if r is not None), None)
+                    result.try_set_failure(
+                        WitnessedElsewhere(txn_id, status, route))
+                    return
+            accept_round()
 
     prep = PrepareCb()
     for to in shard.nodes:
@@ -636,8 +669,14 @@ class MaybeRecover(Callback):
             return
         if merged.route is not None \
                 and not merged.route.covering().contains_ranges(
-                    _to_ranges(self.participants)):
+                    _to_ranges(self.participants)) \
+                and not _to_ranges(self.participants).contains_ranges(
+                    _to_ranges(merged.route.participants)):
             # learn the full participant set, then retry with the full route
+            # -- but ONLY if the route actually adds participants we have not
+            # probed, else this recurses on itself forever (a partially-known
+            # definition can leave route.covering() narrower than the
+            # participants that witnessed it)
             MaybeRecover.probe(self.node, self.txn_id,
                                merged.route.participants,
                                self.allow_invalidate) \

@@ -161,10 +161,33 @@ class ReadTracker(AbstractTracker):
                 self._data.add(i)
         return self._decide()
 
+    def on_partial_data(self, node: NodeId,
+                        unavailable) -> Tuple[RequestStatus, Tuple[NodeId, ...]]:
+        """A reply served SOME slices and reported others unavailable
+        (reference: ReadData replies carry `unavailable` ranges): credit the
+        shards the reply covered, escalate the rest to further replicas.
+        `unavailable` is a Ranges."""
+        from accord_tpu.primitives.keyspace import Ranges
+        for i, st in enumerate(self.shards):
+            if node not in st.shard.nodes or i in self._data:
+                # a shard with data already cannot be failed retroactively:
+                # later replicas' unrelated gaps must not flip a satisfied
+                # shard (and with it the whole round) to FAILED
+                continue
+            if unavailable.intersects(Ranges([st.shard.range])):
+                st.failures.add(node)
+            else:
+                st.successes.add(node)
+                self._data.add(i)
+        return self._escalate(node)
+
     def on_read_failure(self, node: NodeId) -> Tuple[RequestStatus, Tuple[NodeId, ...]]:
         """Returns (status, additional nodes to contact)."""
         for st in self._by_node.get(node, ()):
             st.failures.add(node)
+        return self._escalate(node)
+
+    def _escalate(self, node: NodeId) -> Tuple[RequestStatus, Tuple[NodeId, ...]]:
         more: Set[NodeId] = set()
         for i, st in enumerate(self.shards):
             if i in self._data or node not in st.shard.nodes:

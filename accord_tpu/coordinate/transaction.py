@@ -295,6 +295,26 @@ class _ProposeRound(Callback):
                               else Timeout(f"accept {self.parent.txn_id}"))
 
 
+class _ReadRoundCb(Callback):
+    """Round-stamping adapter: _retry_read replaces the read tracker, and a
+    late reply/timeout from a previous round must not be credited against
+    the NEW tracker (it could mark fresh contacts failed before they
+    answer). Stable votes pass through regardless -- they belong to the
+    txn, not the read round."""
+
+    __slots__ = ("round_", "target")
+
+    def __init__(self, target: "_ExecuteRound", round_no: int):
+        self.target = target
+        self.round_ = round_no
+
+    def on_success(self, from_node, reply) -> None:
+        self.target.on_success(from_node, reply, self.round_)
+
+    def on_failure(self, from_node, failure) -> None:
+        self.target.on_failure(from_node, failure, self.round_)
+
+
 class _ExecuteRound(Callback):
     """Commit(Stable) to every replica; the read rides on one replica per
     shard (reference: ExecuteTxn.java:84-145 + Commit.stableAndRead)."""
@@ -313,6 +333,9 @@ class _ExecuteRound(Callback):
         self.read_tracker = (ReadTracker(parent.topologies, read.keys())
                              if self.needs_read else None)
         self.read_attempts = 0
+        self.read_round = 0   # replies from superseded read rounds are
+                              # ignored for READ accounting (stable votes
+                              # still count -- they are round-independent)
         self.data = None
         self.data_done = not self.needs_read
 
@@ -320,25 +343,32 @@ class _ExecuteRound(Callback):
         p = self.parent
         read_targets = (set(self.read_tracker.initial_contacts(prefer=p.node.id))
                         if self.needs_read else set())
+        cb = _ReadRoundCb(self, self.read_round)
         for to in self.stable_tracker.nodes():
             p.node.send(to, Commit(p.txn_id, p.route, p.txn, p.execute_at,
-                                   p.deps, read=(to in read_targets)), self)
+                                   p.deps, read=(to in read_targets)), cb)
         self._maybe_done()
 
-    def on_success(self, from_node, reply) -> None:
+    def on_success(self, from_node, reply, round_no: int = 0) -> None:
         p = self.parent
         if p.done:
             return
+        current = round_no == self.read_round
         if isinstance(reply, (CommitOk,)):
             self._handle_stable(self.stable_tracker.on_success(from_node))
         elif isinstance(reply, ReadOk):
             if reply.data is not None:
                 self.data = reply.data if self.data is None else self.data.merge(reply.data)
             self._handle_stable(self.stable_tracker.on_success(from_node))
-            if self.needs_read:
-                st = self.read_tracker.on_data_success(from_node)
-                if st == RequestStatus.SUCCESS:
-                    self.data_done = True
+            if self.needs_read and not self.data_done and current:
+                if reply.unavailable is not None:
+                    status, more = self.read_tracker.on_partial_data(
+                        from_node, reply.unavailable)
+                    self._after_read_step(status, more)
+                else:
+                    st = self.read_tracker.on_data_success(from_node)
+                    if st == RequestStatus.SUCCESS:
+                        self.data_done = True
             self._maybe_done()
         elif isinstance(reply, ReadNack):
             # a Commit-with-read replica commits BEFORE attempting the read
@@ -348,19 +378,23 @@ class _ExecuteRound(Callback):
             # proves nothing about the commit and must not be credited.
             if reply.committed:
                 self._handle_stable(self.stable_tracker.on_success(from_node))
-            self._read_failure(from_node)
+            if current:
+                self._read_failure(from_node)
 
-    def on_failure(self, from_node, failure) -> None:
+    def on_failure(self, from_node, failure, round_no: int = 0) -> None:
         if self.parent.done:
             return
         self._handle_stable(self.stable_tracker.on_failure(from_node))
-        if self.needs_read:
+        if self.needs_read and round_no == self.read_round:
             self._read_failure(from_node)
 
     def _read_failure(self, from_node) -> None:
-        if self.read_tracker.decided is not None:
+        if self.data_done or self.read_tracker.decided is not None:
             return
         status, more = self.read_tracker.on_read_failure(from_node)
+        self._after_read_step(status, more)
+
+    def _after_read_step(self, status: RequestStatus, more) -> None:
         if status == RequestStatus.FAILED:
             if self.read_attempts < self.READ_RETRIES:
                 self.read_attempts += 1
@@ -371,8 +405,9 @@ class _ExecuteRound(Callback):
                 self.parent._fail(Exhausted(f"read {self.parent.txn_id}"))
             return
         p = self.parent
+        cb = _ReadRoundCb(self, self.read_round)
         for to in more:
-            p.node.send(to, ReadTxnData(p.txn_id, p.txn, p.execute_at), self)
+            p.node.send(to, ReadTxnData(p.txn_id, p.txn, p.execute_at), cb)
         if status == RequestStatus.SUCCESS:
             self.data_done = True
             self._maybe_done()
@@ -395,10 +430,12 @@ class _ExecuteRound(Callback):
         epoch = max(p.txn_id.epoch, p.node.epoch)
         topologies = p.node.topology_manager.with_unsynced_epochs(
             p.route, epoch, epoch)
+        self.read_round += 1   # retire stale replies from the old round
         self.read_tracker = ReadTracker(topologies, p.txn.read.keys())
+        cb = _ReadRoundCb(self, self.read_round)
         for to in self.read_tracker.initial_contacts(prefer=p.node.id):
             p.node.send(to, Commit(p.txn_id, p.route, p.txn, p.execute_at,
-                                   p.deps, read=True), self)
+                                   p.deps, read=True), cb)
 
     def _handle_stable(self, status: RequestStatus) -> None:
         if status == RequestStatus.FAILED:

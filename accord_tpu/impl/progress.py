@@ -130,11 +130,25 @@ class ProgressEngine:
         only the per-store live-waiter index (maintained by commands.py),
         not every command; stale index entries self-clean here."""
         for store in self.node.command_stores.all():
+            self._maybe_heal_gaps(store)
             for txn_id in list(store.live_waiters):
                 cmd = store.command_if_present(txn_id)
                 wo = cmd.waiting_on if cmd is not None else None
                 if cmd is None or wo is None or wo.is_done() \
                         or cmd.status.is_terminal:
+                    store.live_waiters.discard(txn_id)
+                    continue
+                # wait edges can be created AFTER a range moved away (commits
+                # arriving through the unsynced multi-epoch window), missing
+                # the topology-update reevaluation: elide the blocking edge
+                # here if its shared keys all left current ownership. Checks
+                # only the MIN blocked dep per sweep (cost-bounded; chains
+                # unwind one tick at a time).
+                blocked = min(wo.commit) if wo.commit else (
+                    min(wo.apply) if wo.apply else None)
+                if blocked is not None \
+                        and store.maybe_elide_lost_dep(cmd, blocked) \
+                        and wo.is_done():
                     store.live_waiters.discard(txn_id)
                     continue
                 if txn_id in self.tracked:
@@ -149,6 +163,32 @@ class ProgressEngine:
                 if not store.current_owned().intersects(participants):
                     continue  # frozen leftover on a lost range
                 self.track(txn_id, participants, cmd.status)
+
+    def _maybe_heal_gaps(self, store) -> None:
+        """A data gap on a CURRENTLY-OWNED range means this replica's copy is
+        incomplete yet it is the one coordinators will read from: self-heal
+        by re-acquiring the slice with a bootstrap (ESP floor + snapshot
+        fetch), exactly as if the range had just been added. Without this, a
+        gap marked after the range's last (re-)bootstrap poisons it forever
+        and recovery reads livelock (reference analog: Agent.onStale is the
+        host's cue to re-bootstrap a stale shard)."""
+        gaps = store.data_gaps.intersection(store.current_owned())
+        if gaps.is_empty():
+            return
+        for b in store.active_bootstraps:
+            gaps = gaps.difference(b.ranges)
+        if gaps.is_empty():
+            return
+        # rate-limit: under churn, gaps are marked continuously and a heal
+        # per 250ms sweep tick is a bootstrap storm; one heal per stall
+        # window converges without swamping the cluster
+        now = self.node.now_millis()
+        last = getattr(store, "_last_gap_heal_ms", None)
+        if last is not None and now - last < self.stall_ms:
+            return
+        store._last_gap_heal_ms = now
+        from accord_tpu.local.bootstrap import Bootstrap
+        Bootstrap.run(self.node, store, self.node.epoch, gaps)
 
     def _locally_resolved(self, entry: _Tracked) -> bool:
         """Done when every local store owning the participants has the command
@@ -299,3 +339,10 @@ class StoreProgressLog(ProgressLog):
 
     def clear(self, txn_id: TxnId) -> None:
         self.engine.clear(txn_id)
+
+    def gap_marked(self) -> None:
+        # heal promptly even when no entries are tracked (the tick loop only
+        # runs while something is tracked); the cooldown inside bounds storms
+        eng, store = self.engine, self.store
+        eng.node.scheduler.once(eng.interval_ms,
+                                lambda: eng._maybe_heal_gaps(store))

@@ -138,13 +138,20 @@ def recover(store: CommandStore, txn_id: TxnId, txn: PartialTxn, route: Route,
     if not cmd.known_definition and not cmd.is_(Status.INVALIDATED):
         cmd.txn = txn
         cmd.route = route if cmd.route is None else cmd.route
-        if txn_id.kind is TxnKind.EXCLUSIVE_SYNC_POINT:
-            store.mark_exclusive_sync_point(txn_id, store.owned(txn.keys))
-        witnessed = store.preaccept_timestamp(txn_id, store.owned(txn.keys),
-                                              permit_fast_path=False)
-        cmd.execute_at = witnessed
-        cmd.status = Status.PRE_ACCEPTED
-        store.register(txn_id, txn.keys, CfkStatus.WITNESSED, witnessed)
+        # only witness a timestamp if this replica NEVER witnessed the txn:
+        # an ACCEPTED-without-definition command (Accept carries no txn body)
+        # must keep its accepted executeAt/status -- re-witnessing would
+        # erase the accept that may have formed the commit quorum and let
+        # recovery invalidate a committed txn (reference: preacceptOrRecover
+        # only applies the witness below PreAccepted, local/Commands.java:125-200)
+        if not cmd.has_been(Status.PRE_ACCEPTED):
+            if txn_id.kind is TxnKind.EXCLUSIVE_SYNC_POINT:
+                store.mark_exclusive_sync_point(txn_id, store.owned(txn.keys))
+            witnessed = store.preaccept_timestamp(txn_id, store.owned(txn.keys),
+                                                  permit_fast_path=False)
+            cmd.execute_at = witnessed
+            cmd.status = Status.PRE_ACCEPTED
+            store.register(txn_id, txn.keys, CfkStatus.WITNESSED, witnessed)
         notify_listeners(store, cmd)
     return AcceptOutcome.SUCCESS
 
@@ -412,49 +419,51 @@ def notify_listeners(store: CommandStore, cmd: Command) -> None:
     """Tell every dependent command and transient listener that `cmd` changed
     (reference: AbstractSafeCommandStore.notifyListeners +
     Commands.NotifyWaitingOn)."""
-    for waiter_id in list(cmd.waiters):
-        waiter = store.command_if_present(waiter_id)
-        if waiter is None:
-            cmd.remove_waiter(waiter_id)
-            continue
-        _update_dependency(store, waiter, cmd)
+    # a waiter can only transition when the dep decided its executeAt, became
+    # terminal, or applied (which implies decided): walking the waiter list
+    # on pre-commit changes would visit every edge for nothing. The dep's
+    # state is computed ONCE outside the loop -- this walk is the hottest
+    # protocol loop in the system (reference:
+    # Commands.updateDependencyAndMaybeExecute, local/Commands.java:777).
+    terminal = cmd.is_(Status.INVALIDATED) or cmd.is_(Status.TRUNCATED)
+    if cmd.waiters and (terminal or cmd.known_execute_at):
+        d = cmd.txn_id
+        applied = cmd.has_been(Status.APPLIED)
+        exec_at = cmd.execute_at
+        for waiter_id in list(cmd.waiters):
+            waiter = store.command_if_present(waiter_id)
+            wo = waiter.waiting_on if waiter is not None else None
+            if wo is None:
+                cmd.remove_waiter(waiter_id)
+                continue
+            changed = False
+            if terminal:
+                wo.commit.discard(d)
+                wo.apply.discard(d)
+                cmd.remove_waiter(waiter_id)
+                changed = True
+            elif d in wo.commit:   # executeAt now known
+                wo.commit.discard(d)
+                if applied or (not waiter_id.kind.awaits_only_deps
+                               and exec_at > waiter.execute_at):
+                    cmd.remove_waiter(waiter_id)
+                else:
+                    wo.apply.add(d)
+                changed = True
+            elif applied and d in wo.apply:
+                wo.apply.discard(d)
+                cmd.remove_waiter(waiter_id)
+                changed = True
+            if changed and wo.is_done():
+                store.live_waiters.discard(waiter_id)
+                # defer through the scheduler: a long chain of dependent
+                # commands resolving at once must not recurse (apply A ->
+                # notify B -> apply B -> ...); the reference gets this for
+                # free from per-store executors
+                store.node.scheduler.once(
+                    0.0, lambda w=waiter: maybe_execute(store, w))
     for listener in list(cmd.transient_listeners):
         listener.on_change(store, cmd)
-
-
-def _update_dependency(store: CommandStore, waiter: Command, dep: Command) -> None:
-    """(reference: Commands.updateDependencyAndMaybeExecute, local/Commands.java:777)"""
-    wo = waiter.waiting_on
-    if wo is None:
-        dep.remove_waiter(waiter.txn_id)
-        return
-    d = dep.txn_id
-    changed = False
-    if dep.is_(Status.INVALIDATED) or dep.is_(Status.TRUNCATED):
-        wo.commit.discard(d)
-        wo.apply.discard(d)
-        dep.remove_waiter(waiter.txn_id)
-        changed = True
-    elif d in wo.commit and dep.known_execute_at:
-        wo.commit.discard(d)
-        awaits_all = waiter.txn_id.kind.awaits_only_deps
-        if dep.has_been(Status.APPLIED) or \
-                (not awaits_all and dep.execute_at > waiter.execute_at):
-            dep.remove_waiter(waiter.txn_id)
-        else:
-            wo.apply.add(d)
-        changed = True
-    elif d in wo.apply and dep.has_been(Status.APPLIED):
-        wo.apply.discard(d)
-        dep.remove_waiter(waiter.txn_id)
-        changed = True
-    if changed and wo.is_done():
-        store.live_waiters.discard(waiter.txn_id)
-        # defer through the scheduler: a long chain of dependent commands
-        # resolving at once must not recurse (apply A -> notify B -> apply B
-        # -> ...); the reference gets this for free from per-store executors
-        store.node.scheduler.once(
-            0.0, lambda: maybe_execute(store, waiter))
 
 
 def set_durability(store: CommandStore, txn_id: TxnId, durability: Durability) -> None:

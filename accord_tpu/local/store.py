@@ -466,27 +466,95 @@ class CommandStore:
         self.reevaluate_waiters()
 
     def reevaluate_waiters(self) -> None:
-        """A floor advanced (bootstrap or truncation): previously-registered
-        wait edges may now be elided -- recompute each waiter's needed set
-        and release the ones that became complete."""
+        """A floor advanced (bootstrap or truncation) or a range moved away:
+        previously-registered wait edges may now be elided -- recompute each
+        waiter's needed set and release the ones that became complete.
+
+        Ownership elision: a dep whose every shared key left this store's
+        current ownership can never individually commit here (nobody messages
+        a non-owner), while the handover barrier covered its ordering for the
+        new owners -- keeping the edge would freeze the waiter forever (and
+        with it quiescence). If such a dep is a write whose effects never
+        arrived, the lost slice's data is incomplete: mark the gap so
+        historical reads there report unavailable instead of serving a stale
+        list (reference: markShardStale / RangeUnavailable escalation)."""
         from accord_tpu.local import commands as _commands
-        for cmd in list(self.commands.values()):
-            wo = cmd.waiting_on
+        # only commands with pending wait edges can change: the live_waiters
+        # index is exactly that set (stale entries self-clean in the sweep),
+        # and iterating every command here made churn ticks quadratic
+        for txn_id in list(self.live_waiters):
+            cmd = self.command_if_present(txn_id)
+            wo = cmd.waiting_on if cmd is not None else None
             if wo is None or wo.is_done():
                 continue
             needed = _commands.needed_dep_ids(self, cmd)
             changed = False
             for dep_id in list(wo.commit | wo.apply):
-                if dep_id not in needed:
+                drop = dep_id not in needed
+                if not drop and self.maybe_elide_lost_dep(cmd, dep_id):
+                    continue
+                if drop:
                     wo.commit.discard(dep_id)
                     wo.apply.discard(dep_id)
+                    d = self.command_if_present(dep_id)
+                    if d is not None:
+                        d.remove_waiter(cmd.txn_id)
                     changed = True
             if changed and wo.is_done():
                 self.node.scheduler.once(
                     0.0, lambda c=cmd: _commands.maybe_execute(self, c))
 
+    def maybe_elide_lost_dep(self, cmd, dep_id: TxnId) -> bool:
+        """Elide the wait edge on dep_id iff every key it shares with `cmd`
+        left this store's current ownership (the single test both the
+        reevaluation pass and the progress sweep apply)."""
+        if cmd.deps is None:
+            return False
+        shared = cmd.deps.participants_of(dep_id)
+        if shared is None or not len(shared) \
+                or self.current_owned().intersects(shared):
+            return False
+        self.elide_lost_dep(cmd, dep_id)
+        return True
+
+    def elide_lost_dep(self, cmd, dep_id: TxnId) -> None:
+        """Drop one wait edge whose shared keys all left current ownership
+        (it can never individually resolve here -- see reevaluate_waiters).
+
+        If the dep is a write whose effects never arrived, the slice's local
+        copy is incomplete: mark the data gap so reads there nack instead of
+        serving a stale list (verified necessary: without it, churn seeds
+        produce lost-update anomalies the verifier catches). Gaps on ranges
+        that later cycle back are healed by the progress engine's
+        gap-healing bootstrap (impl/progress.py), so marking cannot
+        permanently poison an owned range."""
+        from accord_tpu.local import commands as _commands
+        from accord_tpu.local.status import Status as _S
+        wo = cmd.waiting_on
+        if wo is None:
+            return
+        if dep_id.kind.is_write and cmd.deps is not None:
+            d = self.command_if_present(dep_id)
+            if d is None or not d.has_been(_S.APPLIED):
+                shared = cmd.deps.participants_of(dep_id)
+                lost = shared.to_ranges() if isinstance(shared, Keys) \
+                    else shared
+                self.mark_gap(lost.intersection(self.ranges))
+        wo.commit.discard(dep_id)
+        wo.apply.discard(dep_id)
+        d = self.command_if_present(dep_id)
+        if d is not None:
+            d.remove_waiter(cmd.txn_id)
+        if wo.is_done():
+            self.live_waiters.discard(cmd.txn_id)
+            self.node.scheduler.once(
+                0.0, lambda c=cmd: _commands.maybe_execute(self, c))
+
     def mark_gap(self, ranges: Ranges) -> None:
+        if ranges.is_empty():
+            return
         self.data_gaps = self.data_gaps.union(ranges)
+        self.progress_log.gap_marked()
 
     def fill_gap(self, ranges: Ranges) -> None:
         self.data_gaps = self.data_gaps.difference(ranges)

@@ -86,6 +86,10 @@ class CommandStores:
                     remainder = b.ranges.intersection(new_owned)
                     if not remainder.is_empty():
                         pending.append(self._bootstrap(s, topology.epoch, remainder))
+                # wait edges on deps whose shared keys all moved away can
+                # never resolve locally -- elide them now (see
+                # CommandStore.reevaluate_waiters ownership elision)
+                s.reevaluate_waiters()
             if not added.is_empty():
                 pending.append(self._bootstrap(s, topology.epoch, added))
         if not pending:

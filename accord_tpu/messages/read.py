@@ -19,14 +19,20 @@ from accord_tpu.utils.async_ import AsyncResult, all_of, success
 
 
 class ReadOk(Reply):
-    __slots__ = ("txn_id", "data")
+    """`unavailable` reports the slices this replica could not serve (data
+    gaps awaiting a snapshot); the coordinator's ReadTracker credits the
+    served shards and escalates the rest (reference: ReadData.ReadOk carries
+    `unavailable` Ranges, messages/ReadData.java)."""
 
-    def __init__(self, txn_id: TxnId, data):
+    __slots__ = ("txn_id", "data", "unavailable")
+
+    def __init__(self, txn_id: TxnId, data, unavailable=None):
         self.txn_id = txn_id
         self.data = data
+        self.unavailable = unavailable
 
     def __repr__(self):
-        return f"ReadOk({self.txn_id!r})"
+        return f"ReadOk({self.txn_id!r}, unavailable={self.unavailable})"
 
 
 class ReadNack(Reply):
@@ -67,28 +73,41 @@ class _ReadWaiter(TransientListener):
             command.remove_transient_listener(self)
             # re-check the data gap: a bootstrap that began AFTER this read
             # started waiting elides pending dep edges (set_bootstrap_floor)
-            # and wakes us before its snapshot has arrived -- serving now
-            # would return data missing acked writes the snapshot carries
-            read_keys = self.txn.read.keys() if self.txn.read is not None else None
-            if read_keys is not None:
-                owned = self.store.owned(read_keys)
-                if len(owned) > 0 and self.store.has_gap(owned.to_ranges()):
-                    self.result.try_set_failure(
-                        RuntimeError(f"{command.txn_id} data gap"))
-                    return
-            self.result.try_set_success(_do_read(self.store, self.txn, self.execute_at))
+            # and wakes us before its snapshot has arrived -- serving those
+            # slices now would return data missing acked writes the snapshot
+            # carries; serve what is clean, report the rest unavailable
+            self.result.try_set_success(
+                _do_read(self.store, self.txn, self.execute_at))
 
 
 def _do_read(store, txn: Txn, execute_at: Timestamp):
+    """Read this store's clean slice; returns (data, unavailable Ranges).
+    Slices under a data GAP must not be served: the bootstrap snapshot never
+    arrived, so deps below its floor were elided without the history being
+    present (reference: CommandStore.safeToRead gating + ReadData's
+    `unavailable` reporting). A replica that merely LOST a range can still
+    serve -- its data below the handover is complete."""
+    from accord_tpu.primitives.keyspace import Ranges
     data = None
     read_keys = txn.read.keys() if txn.read is not None else None
     if read_keys is None:
-        return None
-    for key in store.owned(read_keys):
-        d = txn.read.read(key, store, execute_at)
+        return None, Ranges.EMPTY
+    owned = store.owned(read_keys)
+    if len(owned) == 0:
+        return None, Ranges.EMPTY
+    is_range_read = isinstance(owned, Ranges)
+    owned_ranges = owned if is_range_read else owned.to_ranges()
+    gapped = owned_ranges.intersection(store.data_gaps)
+    if is_range_read:
+        targets = owned.difference(gapped) if not gapped.is_empty() else owned
+    else:
+        targets = owned if gapped.is_empty() else \
+            (k for k in owned if not gapped.contains_key(k))
+    for t in targets:
+        d = txn.read.read(t, store, execute_at)
         if d is not None:
             data = d if data is None else data.merge(d)
-    return data
+    return data, gapped
 
 
 def _read_one_store(store, txn_id: TxnId, txn: Txn, execute_at: Timestamp) -> AsyncResult:
@@ -106,30 +125,20 @@ def _read_one_store(store, txn_id: TxnId, txn: Txn, execute_at: Timestamp) -> As
 def execute_read_when_ready(node, txn_id: TxnId, txn: Txn, execute_at: Timestamp,
                             from_node, reply_context,
                             committed: bool = False) -> None:
+    from accord_tpu.primitives.keyspace import Ranges
     stores = node.command_stores.intersecting(txn.keys)
-    read_keys = txn.read.keys() if txn.read is not None else None
-    if read_keys is not None:
-        # a replica with a data GAP over the read must not serve: its
-        # bootstrap snapshot never arrived, so deps below its floor were
-        # elided without the history being present (reference:
-        # CommandStore.safeToRead gating). A replica that merely LOST the
-        # range can still serve -- its data below the handover is complete,
-        # and readiness (deps applied) guarantees the snapshot at executeAt.
-        # The coordinator's ReadTracker escalates to another replica on nack.
-        for s in stores:
-            owned = s.owned(read_keys)
-            if len(owned) > 0 and s.has_gap(owned.to_ranges()):
-                node.reply(from_node, reply_context,
-                           ReadNack(txn_id, committed))
-                return
     waits = [_read_one_store(s, txn_id, txn, execute_at) for s in stores]
 
-    def merge(datas):
+    def merge(results):
         data = None
-        for d in datas:
+        unavailable = Ranges.EMPTY
+        for d, unav in results:
             if d is not None:
                 data = d if data is None else data.merge(d)
-        node.reply(from_node, reply_context, ReadOk(txn_id, data))
+            unavailable = unavailable.union(unav)
+        node.reply(from_node, reply_context,
+                   ReadOk(txn_id, data,
+                          unavailable if not unavailable.is_empty() else None))
 
     all_of(waits).on_success(merge) \
         .on_failure(lambda _: node.reply(from_node, reply_context,

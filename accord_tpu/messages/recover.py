@@ -278,7 +278,9 @@ class BeginInvalidation(Request):
                 if cmd.promised > self.ballot:
                     return InvalidateNack(self.txn_id, cmd.promised, cmd.route)
                 cmd.promised = self.ballot
-            return InvalidateOk(self.txn_id, cmd.status, cmd.route)
+            fp = cmd.is_(Status.PRE_ACCEPTED) and cmd.execute_at is not None \
+                and cmd.execute_at == self.txn_id.as_timestamp()
+            return InvalidateOk(self.txn_id, cmd.status, cmd.route, fp)
 
         def reduce_fn(a, b):
             if isinstance(a, InvalidateNack) or isinstance(b, InvalidateNack):
@@ -340,12 +342,17 @@ class AcceptInvalidate(Request):
 
 
 class InvalidateOk(Reply):
-    __slots__ = ("txn_id", "status", "route")
+    __slots__ = ("txn_id", "status", "route", "fast_path_vote")
 
-    def __init__(self, txn_id: TxnId, status: Status, route: Optional[Route]):
+    def __init__(self, txn_id: TxnId, status: Status, route: Optional[Route],
+                 fast_path_vote: bool = False):
         self.txn_id = txn_id
         self.status = status
         self.route = route
+        # did this replica cast a ballot-0 fast-path vote (witnessed at
+        # exactly txnId)? Feeds the coordinator's safe-to-invalidate
+        # electorate math (reference: InvalidateReply.acceptedFastPath)
+        self.fast_path_vote = fast_path_vote
 
     def __repr__(self):
         return f"InvalidateOk({self.txn_id!r}, {self.status.name})"

@@ -12,6 +12,7 @@ the tensor-friendly format the TPU deps kernels produce/consume
 """
 from __future__ import annotations
 
+import array
 from bisect import bisect_left
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
@@ -19,11 +20,30 @@ from accord_tpu.primitives.keyspace import Key, Keys, Range, Ranges, Seekables
 from accord_tpu.primitives.timestamp import TxnId
 from accord_tpu.utils import sorted_arrays as sa
 
+import operator
+
+_ts_cmp = operator.attrgetter("_cmp")
+
+
+def _rebuild_keydeps(keys, ids_blob: bytes, offsets_blob: bytes,
+                     value_idx_blob: bytes) -> "KeyDeps":
+    ids = array.array("q")
+    ids.frombytes(ids_blob)
+    it = iter(ids)
+    txn_ids = tuple(TxnId._intern(e, h, f, n)
+                    for e, h, f, n in zip(it, it, it, it))
+    offsets = array.array("i")
+    offsets.frombytes(offsets_blob)
+    value_idx = array.array("i")
+    value_idx.frombytes(value_idx_blob)
+    return KeyDeps(keys, txn_ids, tuple(offsets), tuple(value_idx))
+
 
 class KeyDeps:
     """key -> sorted set of TxnId, as CSR over sorted keys."""
 
-    __slots__ = ("keys", "txn_ids", "offsets", "value_idx")
+    __slots__ = ("keys", "txn_ids", "offsets", "value_idx", "_packed",
+                 "_by_txn")
 
     def __init__(self, keys: Tuple[Key, ...], txn_ids: Tuple[TxnId, ...],
                  offsets: Tuple[int, ...], value_idx: Tuple[int, ...]):
@@ -31,6 +51,26 @@ class KeyDeps:
         self.txn_ids = txn_ids      # sorted unique txn ids (the dictionary)
         self.offsets = offsets      # len(keys)+1 row offsets into value_idx
         self.value_idx = value_idx  # indices into txn_ids, sorted per row
+        self._packed = None         # cached wire blobs (see __reduce__)
+        self._by_txn = None         # cached reverse index (participating_keys)
+
+    def __reduce__(self):
+        # deps sets dominate wire traffic: pack the id dictionary into one
+        # int64 blob (4 lanes per id) and the CSR arrays into int32 blobs --
+        # an order of magnitude fewer pickle ops than the object graph, and
+        # decode interns the ids (see Timestamp.__reduce__). Cached: the same
+        # deps object is pickled once per recipient of every broadcast.
+        if self._packed is None:
+            ids = array.array("q")
+            for t in self.txn_ids:
+                ids.append(t.epoch)
+                ids.append(t.hlc)
+                ids.append(t.flags)
+                ids.append(t.node)
+            self._packed = (self.keys, ids.tobytes(),
+                            array.array("i", self.offsets).tobytes(),
+                            array.array("i", self.value_idx).tobytes())
+        return (_rebuild_keydeps, self._packed)
 
     # -- construction --------------------------------------------------------
     @classmethod
@@ -59,16 +99,20 @@ class KeyDeps:
         return tuple(self.txn_ids[v] for v in self.value_idx[lo:hi])
 
     def participating_keys(self, txn_id: TxnId) -> Keys:
-        """Keys whose dep set includes txn_id (reference: participants())."""
-        i = sa.index_of(self.txn_ids, txn_id)
-        if i < 0:
-            return Keys.EMPTY
-        out = []
-        for row in range(len(self.keys)):
-            lo, hi = self.offsets[row], self.offsets[row + 1]
-            if sa.contains(self.value_idx[lo:hi], i):
-                out.append(self.keys[row])
-        return Keys(out)
+        """Keys whose dep set includes txn_id (reference: participants()).
+        Lazily builds (and caches) the reverse index: the progress engine
+        asks this per blocked dep per sweep, and a row scan per call made
+        sweeps quadratic under contention."""
+        if self._by_txn is None:
+            by: List[list] = [[] for _ in self.txn_ids]
+            for row in range(len(self.keys)):
+                k = self.keys[row]
+                for v in self.value_idx[self.offsets[row]:self.offsets[row + 1]]:
+                    by[v].append(k)
+            self._by_txn = {
+                t: Keys((), _sorted=tuple(ks))   # row order == sorted order
+                for t, ks in zip(self.txn_ids, by)}
+        return self._by_txn.get(txn_id, Keys.EMPTY)
 
     def all_txn_ids(self) -> Tuple[TxnId, ...]:
         return self.txn_ids
@@ -154,7 +198,9 @@ class KeyDepsBuilder:
         if not self._map:
             return KeyDeps.EMPTY
         keys = tuple(sorted(self._map))
-        uniq = sorted(set().union(*self._map.values()))
+        # key= sorts extract _cmp once per element instead of calling __lt__
+        # per comparison -- deps builds are a top-5 simulator cost
+        uniq = sorted(set().union(*self._map.values()), key=_ts_cmp)
         txn_ids = tuple(uniq)
         index = {t: i for i, t in enumerate(uniq)}
         offsets = [0]
@@ -232,7 +278,7 @@ class RangeDeps:
     will use interval bitmaps -- both are internal representations behind the
     same query surface."""
 
-    __slots__ = ("ranges", "txn_ids", "offsets", "value_idx")
+    __slots__ = ("ranges", "txn_ids", "offsets", "value_idx", "_by_txn")
 
     def __init__(self, ranges: Tuple[Range, ...], txn_ids: Tuple[TxnId, ...],
                  offsets: Tuple[int, ...], value_idx: Tuple[int, ...]):
@@ -240,6 +286,25 @@ class RangeDeps:
         self.txn_ids = txn_ids
         self.offsets = offsets
         self.value_idx = value_idx
+        self._by_txn = None   # cached reverse index (participating_ranges)
+
+    def __reduce__(self):
+        # skip the cache slot on the wire
+        return (RangeDeps,
+                (self.ranges, self.txn_ids, self.offsets, self.value_idx))
+
+    def participating_ranges(self, txn_id: TxnId) -> Tuple[Range, ...]:
+        """Ranges whose dep set includes txn_id (lazy cached reverse index,
+        same rationale as KeyDeps.participating_keys)."""
+        if self._by_txn is None:
+            by: List[list] = [[] for _ in self.txn_ids]
+            for row in range(len(self.ranges)):
+                r = self.ranges[row]
+                for v in self.value_idx[self.offsets[row]:self.offsets[row + 1]]:
+                    by[v].append(r)
+            self._by_txn = {t: tuple(rs)
+                            for t, rs in zip(self.txn_ids, by)}
+        return self._by_txn.get(txn_id, ())
 
     @classmethod
     def of(cls, mapping: Dict[Range, Iterable[TxnId]]) -> "RangeDeps":
@@ -402,7 +467,7 @@ class Deps:
         keys = self.key_deps.participating_keys(txn_id)
         if not keys.is_empty():
             return keys
-        rngs = [r for r, ids in self.range_deps.items() if txn_id in ids]
+        rngs = self.range_deps.participating_ranges(txn_id)
         return Ranges(rngs) if rngs else None
 
     def union(self, other: "Deps") -> "Deps":

@@ -38,6 +38,12 @@ class Domain(enum.IntEnum):
     RANGE = 1
 
 
+# interning table for wire-decoded timestamps (see Timestamp.__reduce__);
+# keyed by (class, fields) so TxnId/Ballot/Timestamp never alias
+_INTERNED: dict = {}
+_INTERN_CAP = 1 << 20
+
+
 class TxnKind(enum.IntEnum):
     """Transaction kinds and their conflict-witnessing rules (reference:
     primitives/Txn.java:53 Kind / :125 Kinds)."""
@@ -101,33 +107,44 @@ _WITNESSES = {
 
 
 class Timestamp:
-    """(epoch, hlc, flags, node) with total order. Immutable."""
+    """(epoch, hlc, flags, node) with total order. Immutable BY CONVENTION:
+    nothing in the codebase mutates a constructed timestamp (instances are
+    shared freely, interned across the wire boundary, and used as dict/set
+    keys -- mutating one would corrupt every structure holding it)."""
 
     __slots__ = ("epoch", "hlc", "flags", "node", "_cmp", "_hash")
 
     def __init__(self, epoch: int, hlc: int, flags: int, node: NodeId):
         # bounds are enforced where values originate (unique_now, create,
         # unpack); re-validating on every wire-decode reconstruction is one
-        # of the simulator's top costs
-        object.__setattr__(self, "epoch", epoch)
-        object.__setattr__(self, "hlc", hlc)
-        object.__setattr__(self, "flags", flags)
-        object.__setattr__(self, "node", node)
+        # of the simulator's top costs -- as is any extra work here (this is
+        # the hottest constructor in the system)
+        self.epoch = epoch
+        self.hlc = hlc
+        self.flags = flags
+        self.node = node
         # one order-preserving int for the (epoch, hlc, flags, node) total
         # order: comparisons and hashing are the simulator's hottest ops
         cmp = (((epoch << _HLC_BITS) | hlc) << (_FLAGS_BITS + _NODE_BITS)) \
             | (flags << _NODE_BITS) | node
-        object.__setattr__(self, "_cmp", cmp)
-        object.__setattr__(self, "_hash", hash(cmp))
-
-    def __setattr__(self, *a):
-        raise AttributeError("immutable")
+        self._cmp = cmp
+        self._hash = hash(cmp)
 
     def __reduce__(self):
-        # explicit reduce: the immutable __setattr__ breaks default
-        # slot-state pickling, and the wire boundary (sim/wire.py) pickles
-        # every message
-        return (type(self), (self.epoch, self.hlc, self.flags, self.node))
+        # the wire boundary (sim/wire.py) pickles every message; interning
+        # reconstructed timestamps is safe (immutable) and collapses the
+        # dominant decode cost -- deps sets repeat the same ids endlessly
+        return (type(self)._intern, (self.epoch, self.hlc, self.flags, self.node))
+
+    @classmethod
+    def _intern(cls, epoch: int, hlc: int, flags: int, node: NodeId) -> "Timestamp":
+        key = (cls, epoch, hlc, flags, node)
+        t = _INTERNED.get(key)
+        if t is None:
+            if len(_INTERNED) >= _INTERN_CAP:
+                _INTERNED.clear()  # crude bound; hit rate recovers quickly
+            t = _INTERNED[key] = cls(epoch, hlc, flags, node)
+        return t
 
     # -- ordering ------------------------------------------------------------
     def _key(self) -> Tuple[int, int, int, int]:
@@ -218,9 +235,6 @@ class TxnId(Timestamp):
 
     __slots__ = ()
 
-    def __init__(self, epoch: int, hlc: int, flags: int, node: NodeId):
-        super().__init__(epoch, hlc, flags, node)
-
     @classmethod
     def create(cls, epoch: int, hlc: int, node: NodeId, kind: TxnKind,
                domain: Domain = Domain.KEY) -> "TxnId":
@@ -229,11 +243,13 @@ class TxnId(Timestamp):
 
     @property
     def kind(self) -> TxnKind:
-        return TxnKind(self.flags & _KIND_MASK)
+        # table lookup: enum __call__ is ~5x slower and this is called on
+        # every witness test / waiting-on edge update
+        return _KIND_MEMBERS[self.flags & _KIND_MASK]
 
     @property
     def domain(self) -> Domain:
-        return Domain((self.flags >> _DOMAIN_SHIFT) & 1)
+        return _DOMAIN_MEMBERS[(self.flags >> _DOMAIN_SHIFT) & 1]
 
     @property
     def is_write(self) -> bool:
@@ -256,6 +272,9 @@ class TxnId(Timestamp):
     def __repr__(self):
         return f"{self.kind.name[0]}{'r' if self.domain == Domain.RANGE else ''}[{self.epoch},{self.hlc},{self.node}]"
 
+
+_KIND_MEMBERS = tuple(TxnKind) + (TxnKind.LOCAL_ONLY,) * (8 - len(TxnKind))
+_DOMAIN_MEMBERS = (Domain.KEY, Domain.RANGE)
 
 TxnId.NONE = TxnId(0, 0, 0, 0)
 # MAX sentinel keeps a VALID kind/domain encoding (LOCAL_ONLY + RANGE) so that

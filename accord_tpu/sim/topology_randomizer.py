@@ -74,7 +74,13 @@ class TopologyRandomizer:
         fetch deadlock)."""
         for n in self.cluster.nodes.values():
             for s in n.command_stores.all():
-                if not s.data_gaps.is_empty() or s.active_bootstraps:
+                # only gaps on CURRENTLY-OWNED ranges matter: those stores
+                # are the next epoch's fetch sources and must be complete
+                # first (they self-heal via the progress engine). A gap on a
+                # range the store merely lost never blocks -- it can only
+                # heal through a re-add this very randomizer would issue.
+                if not s.data_gaps.intersection(s.current_owned()).is_empty() \
+                        or s.active_bootstraps:
                     return self.max_pending + 1
         svc = self.cluster.topology_service
         latest = max(svc.epochs)
@@ -144,23 +150,27 @@ class TopologyRandomizer:
         test topology/TopologyRandomizer.java:430): shrink the electorate to
         a random legal subset; excluded replicas are marked `joining` half
         the time (a replica still syncing data votes no fast path)."""
-        i = self.rng.next_int(len(shards))
-        s = shards[i]
-        rf = len(s.nodes)
-        min_e = rf - (rf - 1) // 2
-        size = min_e + self.rng.next_int(rf - min_e + 1)
-        members = list(s.nodes)
-        # deterministic shuffle via indexed picks
-        electorate = set()
-        while len(electorate) < size:
-            electorate.add(members[self.rng.next_int(rf)])
-        excluded = [n for n in s.nodes if n not in electorate]
-        joining = frozenset(n for n in excluded if self.rng.decide(0.5))
-        new = Shard(s.range, s.nodes, frozenset(electorate), joining)
-        if new == s:
-            return None
-        shards[i] = new
-        return shards
+        # a draw can reproduce the existing shard (e.g. the full electorate
+        # again); retry a few times so an electorate mutation reliably lands
+        # when one is possible
+        for _ in range(8):
+            i = self.rng.next_int(len(shards))
+            s = shards[i]
+            rf = len(s.nodes)
+            min_e = rf - (rf - 1) // 2
+            size = min_e + self.rng.next_int(rf - min_e + 1)
+            members = list(s.nodes)
+            # deterministic shuffle via indexed picks
+            electorate = set()
+            while len(electorate) < size:
+                electorate.add(members[self.rng.next_int(rf)])
+            excluded = [n for n in s.nodes if n not in electorate]
+            joining = frozenset(n for n in excluded if self.rng.decide(0.5))
+            new = Shard(s.range, s.nodes, frozenset(electorate), joining)
+            if new != s:
+                shards[i] = new
+                return shards
+        return None
 
     def _bounce_node(self, shards: List[Shard]) -> Optional[List[Shard]]:
         """Remove one node from EVERY shard it replicates (the reference's
