@@ -437,7 +437,7 @@ def propose_invalidate(node, txn_id: TxnId, ballot: Ballot, key,
             self.answered = 0
             self.quorum = False
             self.promised_clean: set = set()   # replied, no prior fast vote
-            self.witnesses: list = []          # (node, status, fast_vote)
+            self.witnesses: list = []          # (node, status, route)
 
         def on_success(self, from_node, reply) -> None:
             if result.done:

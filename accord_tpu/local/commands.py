@@ -210,6 +210,8 @@ def commit(store: CommandStore, txn_id: TxnId, route: Route, txn: Optional[Parti
     store.register(txn_id, cmd.txn.keys, CfkStatus.COMMITTED,
                    max(execute_at, txn_id.as_timestamp()), execute_at)
     _init_waiting_on(store, cmd)
+    if store.exec_plane is not None:
+        store.exec_plane.on_stable(cmd)
     store.progress_log.stable(cmd, _is_home(store, cmd))
     store.node.events.on_stable(cmd)
     notify_listeners(store, cmd)
@@ -278,6 +280,8 @@ def apply(store: CommandStore, txn_id: TxnId, route: Route, txn: Optional[Partia
                    max(execute_at, txn_id.as_timestamp()), execute_at)
     if not was_stable:
         _init_waiting_on(store, cmd)
+    if store.exec_plane is not None:
+        store.exec_plane.on_stable(cmd)   # re-ingest at the apply stage
     store.progress_log.executed(cmd, _is_home(store, cmd))
     notify_listeners(store, cmd)
     maybe_execute(store, cmd)
@@ -425,6 +429,9 @@ def notify_listeners(store: CommandStore, cmd: Command) -> None:
     # state is computed ONCE outside the loop -- this walk is the hottest
     # protocol loop in the system (reference:
     # Commands.updateDependencyAndMaybeExecute, local/Commands.java:777).
+    plane = store.exec_plane
+    if plane is not None:
+        plane.on_status(cmd)
     terminal = cmd.is_(Status.INVALIDATED) or cmd.is_(Status.TRUNCATED)
     if cmd.waiters and (terminal or cmd.known_execute_at):
         d = cmd.txn_id
@@ -456,6 +463,11 @@ def notify_listeners(store: CommandStore, cmd: Command) -> None:
                 changed = True
             if changed and wo.is_done():
                 store.live_waiters.discard(waiter_id)
+                if plane is not None:
+                    # primary exec plane: the RELEASE comes from the device
+                    # frontier harvest (the host wait-graph stays maintained
+                    # as the differential oracle asserted at release time)
+                    continue
                 # defer through the scheduler: a long chain of dependent
                 # commands resolving at once must not recurse (apply A ->
                 # notify B -> apply B -> ...); the reference gets this for

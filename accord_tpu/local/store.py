@@ -68,6 +68,7 @@ class CommandStore:
         self.progress_log = (progress_log_factory(self) if progress_log_factory
                              else _NoopProgressLog())
         self.deps_resolver = deps_resolver  # None -> host scan below
+        self.exec_plane = None              # optional device exec scheduler
         # micro-batch coalescing window for the async device path (resolver
         # owns the per-NODE tick; see ops/resolver.BatchDepsResolver):
         # 0.0 = coalesce same-scheduler-turn arrivals; None = inline (no
@@ -451,6 +452,8 @@ class CommandStore:
         self.range_index.remove(txn_id)
         if self.deps_resolver is not None:
             self.deps_resolver.on_truncate(self, txn_id)
+        if self.exec_plane is not None:
+            self.exec_plane.on_erased(txn_id)
 
     # -- bootstrap floor (reference: local/Bootstrap.java:81 doc :28-80) -----
     def set_bootstrap_floor(self, sync_id: TxnId, ranges: Ranges) -> None:
@@ -500,9 +503,14 @@ class CommandStore:
                     if d is not None:
                         d.remove_waiter(cmd.txn_id)
                     changed = True
-            if changed and wo.is_done():
-                self.node.scheduler.once(
-                    0.0, lambda c=cmd: _commands.maybe_execute(self, c))
+            if changed:
+                if self.exec_plane is not None:
+                    # primary plane: the release comes from the frontier
+                    # harvest (on_edges_changed armed the tick)
+                    self.exec_plane.on_edges_changed(cmd)
+                elif wo.is_done():
+                    self.node.scheduler.once(
+                        0.0, lambda c=cmd: _commands.maybe_execute(self, c))
 
     def maybe_elide_lost_dep(self, cmd, dep_id: TxnId) -> bool:
         """Elide the wait edge on dep_id iff every key it shares with `cmd`
@@ -547,6 +555,10 @@ class CommandStore:
             d.remove_waiter(cmd.txn_id)
         if wo.is_done():
             self.live_waiters.discard(cmd.txn_id)
+        if self.exec_plane is not None:
+            # primary plane: the frontier harvest performs the release
+            self.exec_plane.on_edges_changed(cmd)
+        elif wo.is_done():
             self.node.scheduler.once(
                 0.0, lambda c=cmd: _commands.maybe_execute(self, c))
 

@@ -1,0 +1,326 @@
+"""The device execution scheduler: batched release of the execute-order DAG.
+
+The host engine tracks, per command, the set of undecided/unapplied deps
+gating its execution (WaitingOn; reference local/Command.java:1224) and walks
+waiter lists on every dep transition (Commands.NotifyWaitingOn,
+local/Commands.java:960). That walk is the hottest protocol loop. This plane
+re-expresses the release test as a batched device computation: a per-store
+arena holds each live txn's packed dep-adjacency row plus executeAt /
+applied / pending / awaits-all lanes, and one `execution_frontier` kernel
+call per tick returns the packed set of commands whose gates are all clear.
+
+Modes:
+  - primary: the plane is LOAD-BEARING -- the host wait-graph is still
+    maintained (it is the differential oracle: every release asserts
+    wo.is_done(), so a premature device release trips immediately under
+    paranoia), but release scheduling comes exclusively from harvested
+    frontiers. notify_listeners suppresses its own maybe_execute scheduling.
+  - off (store.exec_plane is None): host walk schedules releases as before.
+
+Determinism: ticks and harvests are scheduler events; dirty-row uploads and
+frontier decodes are pure functions of store state at the tick; release
+order is ascending row index. The async dispatch/harvest split mirrors
+ops/resolver.py's pipeline (enqueue + copy_to_host_async at dispatch; the
+blocking read happens `device_latency_ms` of simulated time later).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from accord_tpu.local.status import Status
+from accord_tpu.ops.encoding import TimestampEncoder
+from accord_tpu.primitives.timestamp import Timestamp, TxnId
+from accord_tpu.utils.invariants import Invariants
+
+_NEG = np.iinfo(np.int32).min
+
+
+class ExecPlane:
+    """One per CommandStore (the wait graph is per-store state)."""
+
+    GROW = 2
+
+    def __init__(self, store, initial_cap: int = 1024,
+                 tick_ms: float = 2.0, device_latency_ms: float = 4.0):
+        self.store = store
+        self.cap = initial_cap
+        self.count = 0
+        self.tick_ms = tick_ms
+        self.device_latency_ms = device_latency_ms
+        self.row_of: Dict[TxnId, int] = {}
+        self.txn_ids: List[TxnId] = []
+        self.encoder: Optional[TimestampEncoder] = None
+        # host shadows (authoritative until scattered)
+        self.adj = np.zeros((self.cap, self.cap // 32), dtype=np.uint32)
+        self.exec_ts = np.full((self.cap, 3), _NEG, dtype=np.int32)
+        self.applied = np.zeros(self.cap, dtype=bool)
+        self.pending = np.zeros(self.cap, dtype=bool)
+        self.awaits_all = np.zeros(self.cap, dtype=bool)
+        self._dirty: set = set()
+        self._device = None
+        self._ticking = False
+        self._gen = 0   # bumped by compaction: retires in-flight frontiers
+                        # whose row indices refer to the old mapping
+        self._compacting = False
+        self._released: set = set()   # rows released (guard double release)
+        # bench/diagnostic counters
+        self.dispatches = 0
+        self.releases = 0
+        self.harvest_stall_s = 0.0
+
+    # -- row management ------------------------------------------------------
+    def _row(self, txn_id: TxnId) -> int:
+        row = self.row_of.get(txn_id)
+        if row is not None:
+            return row
+        if self.encoder is None:
+            self.encoder = TimestampEncoder(0, txn_id.hlc)
+        if self.count == self.cap:
+            self._grow()
+        row = self.count
+        self.count += 1
+        self.row_of[txn_id] = row
+        self.txn_ids.append(txn_id)
+        self._dirty.add(row)
+        return row
+
+    def _ensure_capacity(self, n: int) -> None:
+        """Make room for `n` new rows BEFORE an ingestion allocates them:
+        compaction remaps (and may drop) existing rows, so it must never run
+        between an ingestion's allocations and its writes. Prefers
+        reclaiming dead history (rows stay live only while pending or
+        referenced by a pending wait set) over growing."""
+        if self.cap - self.count >= n:
+            return
+        if self._compacting or not self._compact():
+            while self.cap - self.count < n:
+                self._grow()
+
+    def _compact(self) -> bool:
+        """Rebuild the arena keeping only live rows: pending commands and
+        the deps their wait sets still reference (everything else is settled
+        history that can never gate again). Returns False when compaction
+        would not reclaim at least half the capacity -- the caller grows
+        instead. Rebuilding from the host wait-graph (the oracle) is exact:
+        edges, lanes and flags are re-derived from current command state."""
+        store = self.store
+        self._compacting = True
+        live: List[TxnId] = []
+        seen = set()
+        for row in np.nonzero(self.pending[:self.count])[0].tolist():
+            tid = self.txn_ids[row]
+            cmd = store.command_if_present(tid)
+            if cmd is None:
+                continue
+            if tid not in seen:
+                seen.add(tid)
+                live.append(tid)
+            wo = cmd.waiting_on
+            if wo is not None:
+                for dep in wo.commit | wo.apply:
+                    if dep not in seen:
+                        seen.add(dep)
+                        live.append(dep)
+        if len(live) > self.cap // 2:
+            self._compacting = False
+            return False
+        self.count = 0
+        self.row_of = {}
+        self.txn_ids = []
+        self.adj[:] = 0
+        self.exec_ts[:] = _NEG
+        self.applied[:] = False
+        self.pending[:] = False
+        self.awaits_all[:] = False
+        self._released = set()
+        self._device = None
+        self._dirty = set()
+        self._gen += 1
+        for tid in live:
+            row = self._row(tid)
+            cmd = store.command_if_present(tid)
+            if cmd is None or cmd.has_been(Status.APPLIED) \
+                    or cmd.status.is_terminal:
+                self.applied[row] = True
+                continue
+            if cmd.known_execute_at and cmd.execute_at is not None:
+                self.exec_ts[row] = self.encoder.encode([cmd.execute_at])[0]
+        for tid in live:
+            cmd = store.command_if_present(tid)
+            if cmd is not None and cmd.has_been(Status.STABLE) \
+                    and not cmd.status.is_terminal \
+                    and not cmd.has_been(Status.APPLIED):
+                self.on_stable(cmd)
+        self._compacting = False
+        return True
+
+    def _grow(self) -> None:
+        old_cap = self.cap
+        self.cap *= self.GROW
+        self.adj = np.pad(self.adj, ((0, self.cap - old_cap),
+                                     (0, (self.cap - old_cap) // 32)))
+        self.exec_ts = np.pad(self.exec_ts, ((0, self.cap - old_cap), (0, 0)),
+                              constant_values=_NEG)
+        self.applied = np.pad(self.applied, (0, self.cap - old_cap))
+        self.pending = np.pad(self.pending, (0, self.cap - old_cap))
+        self.awaits_all = np.pad(self.awaits_all, (0, self.cap - old_cap))
+        # column width changed: the device copy must be rebuilt wholesale
+        self._device = None
+
+    # -- hooks from the engine (commands.py) ---------------------------------
+    def on_stable(self, cmd) -> None:
+        """A command became STABLE: ingest its wait edges and pending flag.
+        Called after _init_waiting_on built the (floor-elided) edge set.
+
+        All rows are allocated BEFORE any write: _row can trigger a
+        compaction that remaps every index, so an index held across an
+        allocation would be stale."""
+        wo = cmd.waiting_on
+        dep_ids = tuple(wo.commit | wo.apply) if wo is not None else ()
+        self._ensure_capacity(1 + len(dep_ids))
+        self._row(cmd.txn_id)
+        for dep_id in dep_ids:
+            self._row(dep_id)
+        row = self.row_of[cmd.txn_id]
+        self.awaits_all[row] = cmd.txn_id.kind.awaits_only_deps
+        if cmd.execute_at is not None:
+            self.exec_ts[row] = self.encoder.encode([cmd.execute_at])[0]
+        self.adj[row] = 0
+        for dep_id in dep_ids:
+            d = self.row_of[dep_id]
+            self.adj[row, d >> 5] |= np.uint32(1 << (d & 31))
+        self.pending[row] = True
+        self._released.discard(row)
+        self._dirty.add(row)
+        self._schedule_tick()
+
+    def on_status(self, cmd) -> None:
+        """A command's status advanced (it may gate others): refresh its
+        dep-side lanes."""
+        row = self.row_of.get(cmd.txn_id)
+        if row is None:
+            return
+        if cmd.known_execute_at and cmd.execute_at is not None:
+            self.exec_ts[row] = self.encoder.encode([cmd.execute_at])[0]
+        if cmd.has_been(Status.APPLIED) or cmd.status.is_terminal:
+            self.applied[row] = True
+            self.pending[row] = False
+        self._dirty.add(row)
+        self._schedule_tick()
+
+    def on_edges_changed(self, cmd) -> None:
+        """Floor/ownership elision rewrote the wait set: resync the row.
+        (Rows allocated before writes -- see on_stable.)"""
+        if cmd.txn_id not in self.row_of:
+            return
+        wo = cmd.waiting_on
+        dep_ids = ()
+        if wo is not None and not wo.is_done():
+            dep_ids = tuple(wo.commit | wo.apply)
+            self._ensure_capacity(len(dep_ids))
+            for dep_id in dep_ids:
+                self._row(dep_id)
+        row = self.row_of.get(cmd.txn_id)
+        if row is None:
+            return  # compaction dropped it (no longer pending/referenced)
+        self.adj[row] = 0
+        for dep_id in dep_ids:
+            d = self.row_of[dep_id]
+            self.adj[row, d >> 5] |= np.uint32(1 << (d & 31))
+        self._dirty.add(row)
+        self._schedule_tick()
+
+    def on_erased(self, txn_id: TxnId) -> None:
+        row = self.row_of.get(txn_id)
+        if row is None:
+            return
+        self.applied[row] = True   # an erased record gates nothing
+        self.pending[row] = False
+        self._dirty.add(row)
+        self._schedule_tick()
+
+    # -- the tick/harvest pipeline -------------------------------------------
+    def _schedule_tick(self) -> None:
+        if self._ticking:
+            return
+        self._ticking = True
+        self.store.node.scheduler.once(self.tick_ms, self._tick)
+
+    def _tick(self) -> None:
+        self._ticking = False
+        if not self.pending.any():
+            return
+        if not self._dirty and self._device is not None:
+            # unchanged arena => identical frontier, already harvested; the
+            # next on_* hook re-arms the tick
+            return
+        frontier = self._dispatch()
+        gen = self._gen
+        self.store.node.scheduler.once(
+            self.device_latency_ms, lambda: self._harvest(frontier, gen))
+
+    def _dispatch(self):
+        import jax.numpy as jnp
+        from accord_tpu.ops.kernels import exec_scatter, execution_frontier
+        if self._device is None:
+            # the device adjacency lives UNPACKED (bool[cap, cap]); build it
+            # by scattering every populated row's PACKED form -- the upload
+            # stays cap/8 bytes per row and the device does the expansion
+            self._device = (
+                jnp.zeros((self.cap, self.cap), bool),
+                jnp.full((self.cap, 3), _NEG, jnp.int32),
+                jnp.zeros(self.cap, bool), jnp.zeros(self.cap, bool),
+                jnp.zeros(self.cap, bool))
+            self._dirty = set(range(self.count))
+        if self._dirty:
+            # fancy-indexed selections below COPY, so the async computation
+            # never aliases the live host shadows (zero-copy aliasing on the
+            # CPU backend raced host mutations and broke determinism)
+            rows = np.asarray(sorted(self._dirty), dtype=np.int32)
+            self._device = exec_scatter(
+                *self._device, jnp.asarray(rows),
+                jnp.asarray(self.adj[rows]), jnp.asarray(self.exec_ts[rows]),
+                jnp.asarray(self.applied[rows]),
+                jnp.asarray(self.pending[rows]),
+                jnp.asarray(self.awaits_all[rows]))
+            self._dirty.clear()
+        out = execution_frontier(*self._device)
+        out.copy_to_host_async()
+        self.dispatches += 1
+        return out
+
+    def _harvest(self, frontier, gen: int) -> None:
+        import time as _time
+        from accord_tpu.local import commands as _commands
+        t0 = _time.perf_counter()
+        packed = np.asarray(frontier)
+        self.harvest_stall_s += _time.perf_counter() - t0
+        if gen != self._gen:
+            # compaction remapped rows while this frontier was in flight;
+            # its indices address the old arena -- drop it (the rebuild
+            # re-ingested every pending row, so a fresh tick re-covers them)
+            self._schedule_tick()
+            return
+        rows = np.nonzero(
+            np.unpackbits(packed.view(np.uint8), bitorder="little"))[0]
+        store = self.store
+        for row in rows.tolist():
+            if row >= self.count or row in self._released \
+                    or not self.pending[row]:
+                continue
+            cmd = store.command_if_present(self.txn_ids[row])
+            if cmd is None:
+                continue
+            # differential oracle: the host wait-graph must agree that this
+            # command is releasable -- a premature device release is a bug
+            Invariants.check_state(
+                cmd.waiting_on is None or cmd.waiting_on.is_done(),
+                "device frontier released %s before host WaitingOn drained: %s",
+                cmd.txn_id, cmd.waiting_on)
+            self._released.add(row)
+            self.releases += 1
+            _commands.maybe_execute(store, cmd)
+        if self.pending.any():
+            self._schedule_tick()

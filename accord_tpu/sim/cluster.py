@@ -31,7 +31,8 @@ class ClusterConfig:
                  progress: bool = True, progress_interval_ms: float = 250.0,
                  progress_stall_ms: float = 1500.0, serialize: bool = True,
                  durability: bool = False, durability_interval_ms: float = 500.0,
-                 preaccept_timeout_ms: float = 1000.0):
+                 preaccept_timeout_ms: float = 1000.0,
+                 exec_plane: bool = False, exec_tick_ms: float = 2.0):
         self.num_nodes = num_nodes
         self.rf = min(rf, num_nodes)
         self.num_shards = num_shards
@@ -53,6 +54,10 @@ class ClusterConfig:
         # preaccept expiry (Agent.pre_accept_timeout_ms); high-concurrency
         # benches raise it together with the network timeout
         self.preaccept_timeout_ms = preaccept_timeout_ms
+        # device execution scheduler (ops/exec_plane.py): release execution
+        # wavefronts from the device frontier kernel instead of the host walk
+        self.exec_plane = exec_plane
+        self.exec_tick_ms = exec_tick_ms
 
 
 def build_topology(cfg: ClusterConfig, epoch: int = 1) -> Topology:
@@ -230,6 +235,12 @@ class Cluster:
         if engine is not None:
             engine.bind(node)
             self.progress_engines[node_id] = engine
+        if self.config.exec_plane:
+            from accord_tpu.ops.exec_plane import ExecPlane
+            for store in node.command_stores.all():
+                store.exec_plane = ExecPlane(
+                    store, tick_ms=self.config.exec_tick_ms,
+                    device_latency_ms=self.config.device_latency_ms)
         self.nodes[node_id] = node
         self.network.register_node(node)
         return node
