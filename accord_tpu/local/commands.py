@@ -299,6 +299,13 @@ def needed_dep_ids(store: CommandStore, cmd: Command) -> Set[TxnId]:
     deps that can never individually commit here (reference:
     RedundantBefore's per-range bounds applied in WaitingOn.Update)."""
     deps = cmd.deps.slice(store.ranges) if cmd.deps is not None else None
+    return needed_dep_ids_for(store, deps, cmd.txn_id)
+
+
+def needed_dep_ids_for(store: CommandStore, deps: Optional[Deps],
+                       self_id: TxnId) -> Set[TxnId]:
+    """Core of needed_dep_ids, reusable for dep sets with no command record
+    (ephemeral reads wait on deps without ever becoming commands)."""
     out: Set[TxnId] = set()
     if deps is None or deps.is_empty():
         return out
@@ -316,14 +323,14 @@ def needed_dep_ids(store: CommandStore, cmd: Command) -> Set[TxnId]:
     for k, ids in deps.key_deps.items():
         f = floor_for_key(k)
         for d in ids:
-            if d != cmd.txn_id and (f is None or not d < f):
+            if d != self_id and (f is None or not d < f):
                 out.add(d)
     for r, ids in deps.range_deps.items():
         fb = _min_floor_over_range(store.bootstrapped_at, r.start, r.end)
         ft = _min_floor_over_range(store.truncated_before, r.start, r.end)
         f = fb if ft is None or (fb is not None and fb > ft) else ft
         for d in ids:
-            if d != cmd.txn_id and (f is None or not d < f):
+            if d != self_id and (f is None or not d < f):
                 out.add(d)
     return out
 

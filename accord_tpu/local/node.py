@@ -155,6 +155,10 @@ class Node:
         if txn_id is None:
             txn_id = self.next_txn_id(txn.kind, txn.domain)
         route = self.compute_route(txn)
+        from accord_tpu.primitives.timestamp import TxnKind as _K
+        if txn.kind is _K.EPHEMERAL_READ:
+            from accord_tpu.coordinate.ephemeral import CoordinateEphemeralRead
+            return CoordinateEphemeralRead.coordinate(self, txn_id, txn, route)
         return CoordinateTransaction.coordinate(self, txn_id, txn, route)
 
     def compute_route(self, txn: Txn) -> Route:

@@ -43,3 +43,50 @@ class GetDepsOk(Reply):
 
     def __repr__(self):
         return f"GetDepsOk({self.txn_id!r})"
+
+
+class GetEphemeralReadDeps(Request):
+    """Deps collection for an ephemeral read (reference:
+    messages/GetEphemeralReadDeps.java): every witnessed conflict, no
+    timestamp bound (the read executes after ALL of them), plus the
+    replica's latest epoch so the coordinator can chase topology changes.
+    Registers NOTHING: an ephemeral read is invisible to other txns."""
+
+    def __init__(self, txn_id: TxnId, keys: Seekables):
+        self.txn_id = txn_id
+        self.keys = keys
+        self.wait_for_epoch = txn_id.epoch
+
+    @property
+    def has_side_effects(self) -> bool:
+        return False
+
+    def process(self, node, from_node, reply_context) -> None:
+        def map_fn(store):
+            deps = store.calculate_deps(self.txn_id, store.owned(self.keys),
+                                        Timestamp.MAX)
+            return GetEphemeralReadDepsOk(self.txn_id, deps, node.epoch)
+
+        def reduce_fn(a, b):
+            return GetEphemeralReadDepsOk(
+                self.txn_id, a.deps.union(b.deps),
+                max(a.latest_epoch, b.latest_epoch))
+
+        node.command_stores.map_reduce(self.keys, map_fn, reduce_fn) \
+            .on_success(lambda reply: node.reply(from_node, reply_context, reply)) \
+            .on_failure(node.agent.on_uncaught_exception)
+
+    def __repr__(self):
+        return f"GetEphemeralReadDeps({self.txn_id!r})"
+
+
+class GetEphemeralReadDepsOk(Reply):
+    __slots__ = ("txn_id", "deps", "latest_epoch")
+
+    def __init__(self, txn_id: TxnId, deps: Deps, latest_epoch: int):
+        self.txn_id = txn_id
+        self.deps = deps
+        self.latest_epoch = latest_epoch
+
+    def __repr__(self):
+        return f"GetEphemeralReadDepsOk({self.txn_id!r}, epoch={self.latest_epoch})"

@@ -122,25 +122,30 @@ def _read_one_store(store, txn_id: TxnId, txn: Txn, execute_at: Timestamp) -> As
     return out
 
 
+def _reply_merged_read(node, txn_id: TxnId, from_node, reply_context,
+                       results) -> None:
+    """Merge per-store (data, unavailable) results into one ReadOk."""
+    from accord_tpu.primitives.keyspace import Ranges
+    data = None
+    unavailable = Ranges.EMPTY
+    for d, unav in results:
+        if d is not None:
+            data = d if data is None else data.merge(d)
+        unavailable = unavailable.union(unav)
+    node.reply(from_node, reply_context,
+               ReadOk(txn_id, data,
+                      unavailable if not unavailable.is_empty() else None))
+
+
 def execute_read_when_ready(node, txn_id: TxnId, txn: Txn, execute_at: Timestamp,
                             from_node, reply_context,
                             committed: bool = False) -> None:
-    from accord_tpu.primitives.keyspace import Ranges
     stores = node.command_stores.intersecting(txn.keys)
     waits = [_read_one_store(s, txn_id, txn, execute_at) for s in stores]
 
-    def merge(results):
-        data = None
-        unavailable = Ranges.EMPTY
-        for d, unav in results:
-            if d is not None:
-                data = d if data is None else data.merge(d)
-            unavailable = unavailable.union(unav)
-        node.reply(from_node, reply_context,
-                   ReadOk(txn_id, data,
-                          unavailable if not unavailable.is_empty() else None))
-
-    all_of(waits).on_success(merge) \
+    all_of(waits) \
+        .on_success(lambda results: _reply_merged_read(
+            node, txn_id, from_node, reply_context, results)) \
         .on_failure(lambda _: node.reply(from_node, reply_context,
                                          ReadNack(txn_id, committed)))
 
@@ -165,3 +170,82 @@ class ReadTxnData(Request):
 
     def __repr__(self):
         return f"ReadTxnData({self.txn_id!r})"
+
+
+class EphemeralRead(Request):
+    """Execute an ephemeral read: wait until every (floor-elided) dep has
+    applied locally, then read CURRENT state -- no command record, no
+    registration, nothing persisted (reference: ReadData's
+    readDataWithoutTimestamp mode + ReadEphemeralTxnData,
+    messages/ReadData.java:61-90). Blocked deps are reported to the progress
+    log so recovery unwedges them exactly as for managed reads."""
+
+    def __init__(self, txn_id: TxnId, txn: Txn, deps, execute_epoch: int):
+        self.txn_id = txn_id
+        self.txn = txn
+        self.deps = deps
+        # wait until this replica knows the epoch it was selected from --
+        # processing earlier could find no owning store and reply an empty
+        # (falsely complete) result
+        self.wait_for_epoch = max(txn_id.epoch, execute_epoch)
+
+    @property
+    def has_side_effects(self) -> bool:
+        return False
+
+    def process(self, node, from_node, reply_context) -> None:
+        from accord_tpu.local import commands as _commands
+        stores = [s for s in node.command_stores.intersecting(self.txn.keys)
+                  if len(s.owned(self.txn.keys)) > 0]
+        if not stores:
+            # nothing owned here (mid-handover): nack so the tracker
+            # escalates rather than crediting an empty result
+            node.reply(from_node, reply_context, ReadNack(self.txn_id))
+            return
+        waits = []
+        for store in stores:
+            out: AsyncResult = AsyncResult()
+            waits.append(out)
+            sliced = self.deps.slice(store.ranges)
+            needed = _commands.needed_dep_ids_for(store, sliced, self.txn_id)
+            pending = []
+            for dep_id in sorted(needed):
+                dep = store.command(dep_id)
+                if dep.has_been(Status.APPLIED) or dep.status.is_terminal:
+                    continue
+                pending.append(dep_id)
+            if not pending:
+                out.try_set_success(_do_read(store, self.txn, Timestamp.MAX))
+                continue
+            remaining = {"n": len(pending)}
+
+            class _DepWaiter(TransientListener):
+                def __init__(self, s=store, o=out, r=remaining, t=self.txn):
+                    self.s, self.o, self.r, self.t = s, o, r, t
+
+                def on_change(self, s, command) -> None:
+                    if self.o.done:
+                        command.remove_transient_listener(self)
+                        return
+                    if command.has_been(Status.APPLIED) \
+                            or command.status.is_terminal:
+                        command.remove_transient_listener(self)
+                        self.r["n"] -= 1
+                        if self.r["n"] == 0:
+                            self.o.try_set_success(
+                                _do_read(self.s, self.t, Timestamp.MAX))
+
+            for dep_id in pending:
+                dep = store.command(dep_id)
+                dep.add_transient_listener(_DepWaiter())
+                store.progress_log.waiting(
+                    dep_id, Status.APPLIED, sliced.participants_of(dep_id))
+
+        all_of(waits) \
+            .on_success(lambda results: _reply_merged_read(
+                node, self.txn_id, from_node, reply_context, results)) \
+            .on_failure(lambda _: node.reply(from_node, reply_context,
+                                             ReadNack(self.txn_id)))
+
+    def __repr__(self):
+        return f"EphemeralRead({self.txn_id!r})"

@@ -580,6 +580,12 @@ class BatchDepsResolver(DepsResolver):
         if not isinstance(seekables, Keys):
             # range-domain subjects stay on the host path for now
             return store.host_calculate_deps(txn_id, seekables, before)
+        arena = self._arenas.get(id(store.node))
+        if arena is not None and arena.encoder is not None \
+                and not arena.encoder.in_window(before):
+            # e.g. Timestamp.MAX (ephemeral reads bound by "everything"):
+            # unencodable on device -- the host scan answers
+            return store.host_calculate_deps(txn_id, seekables, before)
         owned = store.owned(seekables)
         deps = self.resolve_batch(store, [(txn_id, owned, before)])[0]
         if store.range_txns:

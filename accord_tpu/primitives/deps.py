@@ -100,19 +100,28 @@ class KeyDeps:
 
     def participating_keys(self, txn_id: TxnId) -> Keys:
         """Keys whose dep set includes txn_id (reference: participants()).
-        Lazily builds (and caches) the reverse index: the progress engine
-        asks this per blocked dep per sweep, and a row scan per call made
-        sweeps quadratic under contention."""
-        if self._by_txn is None:
-            by: List[list] = [[] for _ in self.txn_ids]
+        Per-query memo: the progress engine asks this for the same blocked
+        dep every sweep (a per-call row scan made sweeps quadratic under
+        contention), but building a FULL reverse index per Deps instance is
+        itself a top-5 cost when most instances are queried once."""
+        memo = self._by_txn
+        if memo is None:
+            memo = self._by_txn = {}
+        hit = memo.get(txn_id)
+        if hit is not None:
+            return hit
+        i = sa.index_of(self.txn_ids, txn_id)
+        if i < 0:
+            out = Keys.EMPTY
+        else:
+            ks = []
             for row in range(len(self.keys)):
-                k = self.keys[row]
-                for v in self.value_idx[self.offsets[row]:self.offsets[row + 1]]:
-                    by[v].append(k)
-            self._by_txn = {
-                t: Keys((), _sorted=tuple(ks))   # row order == sorted order
-                for t, ks in zip(self.txn_ids, by)}
-        return self._by_txn.get(txn_id, Keys.EMPTY)
+                lo, hi = self.offsets[row], self.offsets[row + 1]
+                if sa.contains(self.value_idx[lo:hi], i):
+                    ks.append(self.keys[row])
+            out = Keys((), _sorted=tuple(ks))
+        memo[txn_id] = out
+        return out
 
     def all_txn_ids(self) -> Tuple[TxnId, ...]:
         return self.txn_ids
@@ -294,17 +303,23 @@ class RangeDeps:
                 (self.ranges, self.txn_ids, self.offsets, self.value_idx))
 
     def participating_ranges(self, txn_id: TxnId) -> Tuple[Range, ...]:
-        """Ranges whose dep set includes txn_id (lazy cached reverse index,
-        same rationale as KeyDeps.participating_keys)."""
-        if self._by_txn is None:
-            by: List[list] = [[] for _ in self.txn_ids]
-            for row in range(len(self.ranges)):
-                r = self.ranges[row]
-                for v in self.value_idx[self.offsets[row]:self.offsets[row + 1]]:
-                    by[v].append(r)
-            self._by_txn = {t: tuple(rs)
-                            for t, rs in zip(self.txn_ids, by)}
-        return self._by_txn.get(txn_id, ())
+        """Ranges whose dep set includes txn_id (per-query memo, same
+        rationale as KeyDeps.participating_keys)."""
+        memo = self._by_txn
+        if memo is None:
+            memo = self._by_txn = {}
+        hit = memo.get(txn_id)
+        if hit is not None:
+            return hit
+        i = sa.index_of(self.txn_ids, txn_id)
+        out: Tuple[Range, ...] = ()
+        if i >= 0:
+            out = tuple(
+                self.ranges[row] for row in range(len(self.ranges))
+                if sa.contains(
+                    self.value_idx[self.offsets[row]:self.offsets[row + 1]], i))
+        memo[txn_id] = out
+        return out
 
     @classmethod
     def of(cls, mapping: Dict[Range, Iterable[TxnId]]) -> "RangeDeps":

@@ -49,6 +49,7 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
              key_count: int = 32, concurrency: int = 8,
              write_ratio: float = 0.7, max_keys_per_txn: int = 3,
              zipf_theta: float = 0.0,
+             ephemeral_read_ratio: float = 0.0,
              chaos_drop: float = 0.0, chaos_partitions: bool = False,
              topology_churn: bool = False, churn_interval_ms: float = 1000.0,
              crash_restart: bool = False, crash_down_ms: float = 800.0,
@@ -84,6 +85,15 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
             ranges = Ranges([Range(start, max(end, start + 1))])
             txn = Txn(TxnKind.READ, ranges, read=ListRangeRead(ranges),
                       query=ListQuery())
+            return txn, None, {}
+        if ephemeral_read_ratio > 0.0 and wl_rng.decide(ephemeral_read_ratio):
+            # SINGLE-key ephemeral read: strict-serializable (multi-key
+            # ephemeral reads are only per-key linearizable -- reference
+            # CoordinateEphemeralRead.java class doc -- and would trip the
+            # cross-key checker)
+            key = pick_key()
+            txn = Txn(TxnKind.EPHEMERAL_READ, Keys([key]),
+                      read=ListRead(Keys([key])), query=ListQuery())
             return txn, None, {}
         nkeys = wl_rng.next_int_between(1, max_keys_per_txn + 1)
         chosen = Keys(pick_key() for _ in range(nkeys))
