@@ -77,8 +77,15 @@ class CoordinateEphemeralRead(Callback):
         # replies report a later epoch): deps must come from quorums of the
         # epoch the read will execute in, else a write witnessed only by
         # new-epoch replicas could be missed
-        if self.latest_epoch > self.collected_epoch \
-                and self.chases < self.MAX_EPOCH_CHASES:
+        if self.latest_epoch > self.collected_epoch:
+            if self.chases >= self.MAX_EPOCH_CHASES:
+                # deps from a stale-epoch quorum must NOT execute against the
+                # newer topology (a new-epoch-only write could be missed):
+                # abandon -- the client retries with a fresh txn id
+                self.result.try_set_failure(Timeout(
+                    f"ephemeral {self.txn_id}: epochs outran "
+                    f"{self.MAX_EPOCH_CHASES} deps rounds"))
+                return
             self.chases += 1
             target = self.latest_epoch
 

@@ -79,7 +79,9 @@ class CoordinateTransaction:
             self.node.send(to, PreAccept(self.txn_id, self.txn, self.route), round_)
 
     def _on_preaccepted(self, round_: "_PreAcceptRound") -> None:
-        if round_.tracker.has_fast_path_accepted():
+        from accord_tpu.utils import faults
+        if round_.tracker.has_fast_path_accepted() \
+                and not faults.FAST_PATH_DISABLED:
             # (reference: CoordinateTransaction.java:73-77)
             self.execute_at = self.txn_id.as_timestamp()
             self.deps = Deps.merge([ok.deps for ok in round_.oks.values()
@@ -155,7 +157,13 @@ class CoordinateTransaction:
                                       self.deps), round_)
 
     def _on_accepted(self, round_: "_ProposeRound") -> None:
-        self.deps = Deps.merge([self.deps] + [ok.deps for ok in round_.oks.values()])
+        from accord_tpu.utils import faults
+        skip = faults.SYNCPOINT_UNMERGED_DEPS \
+            if self.txn_id.kind.is_sync_point \
+            else faults.TRANSACTION_UNMERGED_DEPS
+        if not skip:
+            self.deps = Deps.merge(
+                [self.deps] + [ok.deps for ok in round_.oks.values()])
         self._start_execute()
 
     # -- phase 3: Stabilise + Execute (commit-and-read overlap) --------------

@@ -111,14 +111,35 @@ class CommandStore:
         self.truncated_before: ReducingRangeMap = ReducingRangeMap.EMPTY
 
     # -- execution context ---------------------------------------------------
+    # async_delay_us: when set (the adversarial simulator), every store op is
+    # deferred through the scheduler by its returned delay -- modeling the
+    # reference's async command loads / cache misses (DelayedCommandStores,
+    # test impl/basic/DelayedCommandStores.java:71 + Cluster.java:414
+    # isLoadedCheck). Ops stay atomic; only their ORDER relative to other
+    # events (and each other across stores) changes.
+    async_delay_us: Optional[Callable[[], int]] = None
+
     def execute(self, fn: Callable[["CommandStore"], None]) -> AsyncResult:
         """Run an operation against this store. Synchronous by default; the
-        simulator overrides submit scheduling to add async load delays."""
-        fn(self)
-        return success(None)
+        simulator injects async load delays via async_delay_us."""
+        if self.async_delay_us is None:
+            fn(self)
+            return success(None)
+        return self.submit(fn).map(lambda _: None)
 
     def submit(self, fn: Callable[["CommandStore"], object]) -> AsyncResult:
-        return success(fn(self))
+        if self.async_delay_us is None:
+            return success(fn(self))
+        out: AsyncResult = AsyncResult()
+
+        def run():
+            try:
+                out.try_set_success(fn(self))
+            except BaseException as e:  # noqa: BLE001 -- route to the chain
+                out.try_set_failure(e)
+
+        self.node.scheduler.once(self.async_delay_us() / 1000.0, run)
+        return out
 
     # -- command access ------------------------------------------------------
     def command(self, txn_id: TxnId) -> Command:

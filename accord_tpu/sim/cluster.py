@@ -32,7 +32,10 @@ class ClusterConfig:
                  progress_stall_ms: float = 1500.0, serialize: bool = True,
                  durability: bool = False, durability_interval_ms: float = 500.0,
                  preaccept_timeout_ms: float = 1000.0,
-                 exec_plane: bool = False, exec_tick_ms: float = 2.0):
+                 exec_plane: bool = False, exec_tick_ms: float = 2.0,
+                 store_delays: bool = False, store_delay_max_us: int = 2000,
+                 clock_drift: bool = False, clock_offset_max_us: int = 100_000,
+                 clock_drift_max_ppm: int = 10_000):
         self.num_nodes = num_nodes
         self.rf = min(rf, num_nodes)
         self.num_shards = num_shards
@@ -58,6 +61,13 @@ class ClusterConfig:
         # wavefronts from the device frontier kernel instead of the host walk
         self.exec_plane = exec_plane
         self.exec_tick_ms = exec_tick_ms
+        # adversarial simulator knobs (reference: DelayedCommandStores async
+        # loads + per-node clock drift, burn/BurnTest.java:330-340)
+        self.store_delays = store_delays
+        self.store_delay_max_us = store_delay_max_us
+        self.clock_drift = clock_drift
+        self.clock_offset_max_us = clock_offset_max_us
+        self.clock_drift_max_ppm = clock_drift_max_ppm
 
 
 def build_topology(cfg: ClusterConfig, epoch: int = 1) -> Topology:
@@ -216,6 +226,15 @@ class Cluster:
                 interval_ms=self.config.progress_interval_ms,
                 stall_ms=self.config.progress_stall_ms)
             progress_factory = engine.log_for
+        time_service = self.time_service
+        if self.config.clock_drift:
+            from accord_tpu.sim.scheduler import DriftingTimeService
+            drift_rng = self._node_rngs[node_id].fork()
+            offset = drift_rng.next_int(2 * self.config.clock_offset_max_us) \
+                - self.config.clock_offset_max_us
+            ppm = drift_rng.next_int(2 * self.config.clock_drift_max_ppm) \
+                - self.config.clock_drift_max_ppm
+            time_service = DriftingTimeService(self.queue, offset, ppm)
         node = Node(
             node_id,
             message_sink=self.network.sink_for(node_id),
@@ -223,7 +242,7 @@ class Cluster:
             scheduler=NodeScheduler(self.queue, alive),
             agent=SimAgent(self, node_id),
             rng=self._node_rngs[node_id].fork(),
-            time_service=self.time_service,
+            time_service=time_service,
             data_store=self.stores[node_id],
             num_stores=self.config.stores_per_node,
             progress_log_factory=progress_factory,
@@ -241,6 +260,16 @@ class Cluster:
                 store.exec_plane = ExecPlane(
                     store, tick_ms=self.config.exec_tick_ms,
                     device_latency_ms=self.config.device_latency_ms)
+        if self.config.store_delays:
+            # async store-op delays (reference: DelayedCommandStores): each
+            # store defers every op by a deterministic random delay,
+            # injecting the reentrancy/interleaving surface inline stores
+            # never exercise
+            for store in node.command_stores.all():
+                delay_rng = self._node_rngs[node_id].fork()
+                store.async_delay_us = (
+                    lambda r=delay_rng,
+                    m=self.config.store_delay_max_us: r.next_int(m))
         self.nodes[node_id] = node
         self.network.register_node(node)
         return node

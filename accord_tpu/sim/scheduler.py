@@ -81,3 +81,21 @@ class SimTimeService(TimeService):
 
     def now_micros(self) -> int:
         return self.queue.now_micros
+
+
+class DriftingTimeService(TimeService):
+    """Per-node clock with a fixed offset and a frequency error (reference:
+    the burn test's per-node clock drift via FrequentLargeRange,
+    burn/BurnTest.java:330-340): node time = base * (1 + drift_ppm/1e6)
+    + offset. Monotonic because the base queue clock is; HLC uniqueness is
+    enforced downstream by Node.unique_now regardless of skew."""
+
+    def __init__(self, queue: PendingQueue, offset_us: int, drift_ppm: int):
+        self.queue = queue
+        self.offset_us = offset_us
+        self.drift_ppm = drift_ppm
+
+    def now_micros(self) -> int:
+        base = self.queue.now_micros
+        return max(0, base + self.offset_us
+                   + (base * self.drift_ppm) // 1_000_000)
