@@ -633,11 +633,7 @@ class MaybeRecover(Callback):
             # otherwise mark local records truncated so dependents stop
             # waiting (reference: Infer/Cleanup propagation of truncation)
             self._acted = True
-            outcome_available = (
-                merged.partial_txn is not None
-                and merged.execute_at is not None
-                and (not self.txn_id.kind.is_write or merged.writes is not None))
-            if outcome_available:
+            if merged.known_outcome:
                 self._propagate_outcome(merged)
             else:
                 self._propagate_truncated(merged)
@@ -661,8 +657,7 @@ class MaybeRecover(Callback):
         if merged.status == Status.INVALIDATED:
             self._propagate_invalidate(merged)
             return
-        if merged.route is not None and merged.partial_txn is not None \
-                and merged.partial_txn.covers(merged.route.covering()):
+        if merged.known_definition:
             txn = merged.partial_txn.reconstitute()
             Recover.recover(self.node, self.txn_id, txn, merged.route) \
                 .add_callback(self._finish)

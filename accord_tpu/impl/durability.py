@@ -127,10 +127,10 @@ class CoordinateGloballyDurable(Callback):
                 if s < e:
                     out_segments.append((s, e, ts))
         if out_segments:
+            from accord_tpu.messages.durability import apply_globally_durable
             for to in self.topology.nodes():
                 if to == self.node.id:
-                    for s in self.node.command_stores.all():
-                        s.mark_globally_durable(out_segments)
+                    apply_globally_durable(self.node, out_segments)
                 else:
                     self.node.send(to, SetGloballyDurable(out_segments))
         self.result.try_set_success(len(out_segments))

@@ -97,9 +97,36 @@ class SetGloballyDurable(Request):
         self.wait_for_epoch = 0
 
     def process(self, node, from_node, reply_context) -> None:
-        for s in node.command_stores.all():
-            s.mark_globally_durable(self.segments)
+        apply_globally_durable(node, self.segments)
         node.reply(from_node, reply_context, DurableAck(None))
 
     def __repr__(self):
         return f"SetGloballyDurable({len(self.segments)} segments)"
+
+
+def apply_globally_durable(node, segments: List[Tuple]) -> None:
+    """Advance every store's universal floor, then retire topology epochs
+    below the floor's minimum epoch (reference: TopologyManager epoch
+    truncation via reportEpochRedundant): every txn from those epochs is
+    applied at every replica (or can never commit), so coordinations will
+    never need their quorums. Shared by the message handler and the global
+    coordinator's self-application so both paths retire identically."""
+    for s in node.command_stores.all():
+        s.mark_globally_durable(segments)
+    if not segments:
+        return
+    # retire ONLY when the floor covers the WHOLE keyspace: a global round
+    # can carry a partial segment set (a shard whose replica missed the
+    # query contributes nothing), and taking the min over just the present
+    # segments would retire epochs a non-durable shard's recovery still
+    # needs (its original electorate)
+    from accord_tpu.primitives.keyspace import Range, Ranges
+    covered = Ranges.EMPTY
+    for start, end, _ in segments:
+        covered = covered.union(Ranges([Range(start, end)]))
+    topology = node.topology_manager.current()
+    whole = Ranges([s.range for s in topology.shards])
+    if not covered.contains_ranges(whole):
+        return
+    floor_epoch = min(ts.epoch for _, _, ts in segments)
+    node.topology_manager.retire_below(floor_epoch)

@@ -479,5 +479,22 @@ class CheckStatusOk(Reply):
             txn, deps, writes,
             hi.result if hi.result is not None else lo.result)
 
+    # -- the decision-relevant slice of the reference's Known vector
+    # (Status.Known, local/Status.java:126-133); only the two predicates the
+    # probe's decision table consumes are materialized --------------------
+    @property
+    def known_definition(self) -> bool:
+        """Definition known FOR THE FULL ROUTE (a partial slice is not
+        enough to re-coordinate)."""
+        return self.route is not None and self.partial_txn is not None \
+            and self.partial_txn.covers(self.route.covering())
+
+    @property
+    def known_outcome(self) -> bool:
+        """An applyable outcome: executeAt + definition + (for writes) the
+        writes themselves."""
+        return (self.partial_txn is not None and self.execute_at is not None
+                and (not self.txn_id.kind.is_write or self.writes is not None))
+
     def __repr__(self):
         return f"CheckStatusOk({self.txn_id!r}, {self.status.name})"

@@ -83,8 +83,33 @@ class TopologyManager:
 
     def for_epoch(self, epoch: int) -> Topology:
         st = self._epochs.get(epoch)
+        if st is None and self._epochs and epoch < min(self._epochs):
+            # retired epoch: every txn of that epoch is below the universal
+            # durability floor (see retire_below), so any probe/recovery for
+            # one resolves TRUNCATED -- the oldest retained topology answers
+            # for the contact set
+            return self._epochs[min(self._epochs)].topology
         Invariants.check_state(st is not None, "unknown epoch %s", epoch)
         return st.topology
+
+    def retire_below(self, epoch: int) -> None:
+        """Drop epochs strictly below `epoch` (reference:
+        TopologyManager.java:75-131 closed/complete range retirement --
+        re-keyed here to the universal durability floor, which subsumes the
+        closed-range reasoning: below it nothing can need an old quorum).
+        Never drops the current epoch or any epoch the unsynced-window
+        extension could still reach."""
+        if not self._epochs:
+            return
+        # keep the newest synced epoch <= every retained unsynced window:
+        # with_unsynced_epochs walks DOWN from a coordination's min epoch
+        # until it finds a synced one -- that epoch must survive
+        keep = min(epoch, self._current_epoch)
+        lo = min(self._epochs)
+        while keep > lo and not self.is_synced(keep):
+            keep -= 1
+        for e in [e for e in self._epochs if e < keep]:
+            del self._epochs[e]
 
     def has_epoch(self, epoch: int) -> bool:
         return epoch in self._epochs
@@ -110,7 +135,13 @@ class TopologyManager:
 
     # -- the coordination contact-set computations ---------------------------
     def precise_epochs(self, min_epoch: int, max_epoch: int) -> Topologies:
-        """Topologies for exactly [min_epoch, max_epoch], newest first."""
+        """Topologies for [min_epoch, max_epoch], newest first (clamped to
+        the retained window: retired epochs are answered by the oldest
+        retained topology -- see retire_below)."""
+        min_epoch = max(min_epoch, self.min_epoch())
+        max_epoch = max(max_epoch, min_epoch)   # fully-retired window: the
+        # oldest retained topology answers (any such txn is below the
+        # universal durability floor, so replies resolve TRUNCATED)
         tops = [self._epochs[e].topology for e in range(max_epoch, min_epoch - 1, -1)]
         return Topologies(tops)
 
@@ -118,8 +149,8 @@ class TopologyManager:
         """Epochs [min', max_epoch] where min' extends below min_epoch while
         epochs remain unsynced (so coordinations keep contacting the old
         replica sets until handover quorums complete)."""
-        lo = min_epoch
         floor = self.min_epoch()
+        lo = max(min_epoch, floor)
         while lo > floor and not self.is_synced(lo):
             lo -= 1
         return self.precise_epochs(lo, max_epoch)

@@ -1,0 +1,143 @@
+"""Partial-knowledge repair must CONCLUDE, not retry: a single MaybeRecover
+probe round resolves a stalled txn whenever the merged Known vector permits
+(reference: the Known lattice local/Status.java:126-133 + Infer.java:61 +
+Propagate.java:64). Each test builds real cluster state, runs ONE probe, and
+asserts the conclusion without further probe rounds."""
+from __future__ import annotations
+
+import pytest
+
+from accord_tpu.coordinate.recover import MaybeRecover, Outcome
+from accord_tpu.primitives.keyspace import Keys
+from accord_tpu.primitives.timestamp import TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.sim.cluster import Cluster, ClusterConfig
+from accord_tpu.sim.list_store import ListQuery, ListRead, ListUpdate
+
+
+def _mk_cluster(seed=3):
+    return Cluster(seed, ClusterConfig(num_nodes=3, rf=3, progress=False))
+
+
+def _write_txn(key, value):
+    return Txn(TxnKind.WRITE, Keys([key]), read=ListRead(Keys([key])),
+               update=ListUpdate(Keys([key]), value), query=ListQuery())
+
+
+def _run_probe(cluster, node, txn_id, participants):
+    """One probe; returns (value, failure, extra_probe_rounds)."""
+    rounds = [0]
+    orig = MaybeRecover.probe.__func__
+
+    def counting(cls, n, t, p, allow_invalidate=True):
+        rounds[0] += 1
+        return orig(cls, n, t, p, allow_invalidate)
+
+    MaybeRecover.probe = classmethod(counting)
+    out = []
+    try:
+        MaybeRecover.probe(node, txn_id, participants) \
+            .add_callback(lambda v, f: out.append((v, f)))
+        cluster.drain(max_events=200000)
+    finally:
+        MaybeRecover.probe = classmethod(orig)
+    assert out, "probe never completed"
+    v, f = out[0]
+    return v, f, rounds[0] - 1
+
+
+def _commit_one(cluster, key, value):
+    """Run a write to completion; return its txn_id."""
+    node = cluster.nodes[1]
+    done = []
+    txn = _write_txn(key, value)
+    txn_id = node.next_txn_id(txn.kind, txn.domain)
+    node.coordinate(txn, txn_id=txn_id).add_callback(
+        lambda v, f: done.append((v, f)))
+    cluster.drain(max_events=200000)
+    assert done and done[0][1] is None, f"setup write failed: {done}"
+    return txn_id
+
+
+def test_outcome_propagates_in_one_probe():
+    """A txn APPLIED on its peers repairs a replica that lost its record:
+    the merged reply carries a full Known outcome, applied locally without
+    recovery rounds (reference: Propagate)."""
+    cluster = _mk_cluster()
+    key = 100
+    txn_id = _commit_one(cluster, key, 7)
+    # amnesiac replica: node 3 forgets the txn entirely
+    victim = cluster.nodes[3]
+    for store in victim.command_stores.all():
+        if store.command_if_present(txn_id) is not None:
+            del store.commands[txn_id]
+    v, f, extra = _run_probe(cluster, victim, txn_id, Keys([key]))
+    assert f is None, f
+    assert v == Outcome.APPLIED
+    assert extra == 0, f"{extra} extra probe rounds"
+    for store in victim.command_stores.all():
+        if store.owns(Keys([key])):
+            cmd = store.command_if_present(txn_id)
+            assert cmd is not None and cmd.has_been(
+                __import__("accord_tpu.local.status",
+                           fromlist=["Status"]).Status.APPLIED)
+
+
+def test_unwitnessed_txn_invalidates_in_one_probe():
+    """A txn id no replica ever witnessed: the probe concludes INVALIDATED
+    (Infer IfUndecided -- nothing decided anywhere, all replicas answered)
+    without extra probe rounds."""
+    cluster = _mk_cluster()
+    node = cluster.nodes[1]
+    key = 200
+    ghost = node.next_txn_id(TxnKind.WRITE, Keys([key]).domain)
+    v, f, extra = _run_probe(cluster, node, ghost, Keys([key]))
+    assert f is None, f
+    assert v == Outcome.INVALIDATED
+    assert extra == 0, f"{extra} extra probe rounds"
+
+
+def test_preaccepted_only_invalidates_in_one_probe():
+    """Witnessed on ONE replica but never accepted anywhere: with every
+    reachable replica answered and the electorate's fast path decisively
+    dead (promises block future votes), the probe race-invalidates instead
+    of retrying forever (the round-3 livelock shape)."""
+    from accord_tpu.local import commands
+    cluster = _mk_cluster()
+    node = cluster.nodes[1]
+    key = 300
+    txn = _write_txn(key, 9)
+    txn_id = node.next_txn_id(txn.kind, txn.domain)
+    route = node.compute_route(txn)
+    # witness on node 2 only (the abandoned coordinator's lone PreAccept)
+    for store in cluster.nodes[2].command_stores.all():
+        if store.owns(Keys([key])):
+            commands.preaccept(store, txn_id, txn.slice(store.ranges, False),
+                               route)
+    v, f, extra = _run_probe(cluster, node, txn_id, Keys([key]))
+    assert f is None, f
+    assert v == Outcome.INVALIDATED
+    assert extra == 0, f"{extra} extra probe rounds"
+
+
+def test_truncated_everywhere_concludes_in_one_probe():
+    """Every replica truncated the record (outcome durably applied and
+    erased): the probe concludes TRUNCATED from the merged knowledge."""
+    cluster = _mk_cluster()
+    key = 400
+    txn_id = _commit_one(cluster, key, 11)
+    for n in cluster.nodes.values():
+        for store in n.command_stores.all():
+            cmd = store.command_if_present(txn_id)
+            if cmd is None:
+                continue
+            del store.commands[txn_id]
+            ts = txn_id.as_timestamp().with_next_hlc()
+            from accord_tpu.primitives.timestamp import Timestamp
+            store.truncated_before = store.truncated_before.with_range(
+                key, key + 1, ts, Timestamp.merge_max)
+    victim = cluster.nodes[2]
+    v, f, extra = _run_probe(cluster, victim, txn_id, Keys([key]))
+    assert f is None, f
+    assert v in (Outcome.TRUNCATED, Outcome.APPLIED)
+    assert extra == 0, f"{extra} extra probe rounds"
