@@ -13,8 +13,10 @@ contents reproducible from Commit/Apply messages is what makes the two paths
 differentially testable.
 
 The reference additionally compresses deps implicitly (store only missing[]
-divergences) and prunes via prunedBefore; we keep explicit per-key id sets and
-will add pruning with the durability/truncation milestone.
+divergences); we keep explicit per-key id sets, pruned behind the
+majority-durability floor (prune_below, driven by CommandStore.cleanup) --
+the injected floor dep subsumes pruned entries' ordering, mirroring the
+reference's prunedBefore.
 """
 from __future__ import annotations
 
@@ -74,6 +76,25 @@ class CommandsForKey:
         if txn_id in self._infos:
             del self._infos[txn_id]
             self._sorted = None
+
+    def prune_below(self, floor: Timestamp) -> List[TxnId]:
+        """Drop APPLIED/INVALIDATED entries wholly below `floor` (the
+        majority-durable sync point for this key): the injected floor dep
+        subsumes their ordering for every future subject, so the scan no
+        longer needs them (reference: cfk pruning via prunedBefore,
+        local/cfk/Pruning.java:41, CommandsForKey.java:113-146). Entries not
+        yet applied stay regardless of age. Returns the pruned ids (the
+        store mirrors the removal into the device arena)."""
+        pruned = [
+            t for t, info in self._infos.items()
+            if info.status in (CfkStatus.APPLIED, CfkStatus.INVALIDATED)
+            and t < floor
+            and (info.execute_at is None or info.execute_at < floor)]
+        for t in pruned:
+            del self._infos[t]
+        if pruned:
+            self._sorted = None
+        return pruned
 
     # -- queries -------------------------------------------------------------
     def _ids(self) -> List[TxnId]:

@@ -436,6 +436,25 @@ class CommandStore:
             if not cmd.cleaned:
                 self._deregister(cmd)
             self.progress_log.clear(txn_id)
+        if not shrink_floor.is_empty():
+            # PER-KEY cfk pruning (reference: cfk prunedBefore,
+            # local/cfk/Pruning.java:41): applied entries below a key's
+            # majority floor leave the registry even while their COMMAND
+            # record lives on (partially-floored commands, retained outcomes,
+            # lingering waiters) -- the injected floor dep subsumes their
+            # ordering for every future scan. Bounds per-key set sizes
+            # between truncation rounds.
+            for key in list(self.cfks):
+                floor = shrink_floor.get(key)
+                if floor is None:
+                    continue
+                c = self.cfks[key]
+                pruned = c.prune_below(floor)
+                if pruned and self.deps_resolver is not None:
+                    for t in pruned:
+                        self.deps_resolver.on_prune(self, t, (key,))
+                if c.is_empty():
+                    del self.cfks[key]
         if not erase_floor.is_empty():
             # advance the truncation horizon over the whole erased region: ids
             # below it either applied durably, were invalidated, or can never

@@ -66,6 +66,10 @@ class DepsResolver:
     def on_truncate(self, store, txn_id: TxnId) -> None:
         """Observer hook: the store truncated this txn's local record."""
 
+    def on_prune(self, store, txn_id: TxnId, keys) -> None:
+        """Observer hook: the store pruned this txn from `keys`' conflict
+        registries (its ordering is subsumed by the injected floor dep)."""
+
 
 class HostDepsResolver(DepsResolver):
     def resolve_one(self, store, txn_id, seekables, before) -> Deps:
@@ -152,6 +156,9 @@ class _NodeArena:
         self.had_truncation = False
         self._dirty_rows: set = set()
         self._device = None
+        # bumped by compact(): retires in-flight async calls whose packed
+        # rows address the old row mapping (they fall back to the host scan)
+        self.gen = 0
 
     # -- host-side mutation ---------------------------------------------------
     def _ensure_encoder(self, ts: Timestamp) -> None:
@@ -176,6 +183,62 @@ class _NodeArena:
                                       (0, (new_cap - self.cap) // 32))
         self.cap = new_cap
 
+    def compact(self) -> bool:
+        """Rebuild the arena keeping only rows that still carry keys: pruned
+        /truncated rows (empty key_sets) are settled history no scan can
+        match. Returns False when that would reclaim less than half the
+        capacity (caller grows instead). Bumps `gen`: in-flight async calls
+        hold packed rows in the OLD mapping and fall back to the host scan
+        at harvest."""
+        live = [i for i in range(self.count) if self.key_sets[i]]
+        if len(live) > self.cap // 2:
+            return False
+        old_ids = self.txn_ids
+        old_keys = self.key_sets
+        old_exec = self.exec_max
+        old_ts = self.ts.copy()
+        old_exec_ts = self.exec_ts.copy()
+        old_kinds = self.kinds.copy()
+        old_invalidated = self.invalidated
+        self.count = 0
+        self.txn_ids = []
+        self.key_sets = []
+        self.exec_max = []
+        self.row_of = {}
+        self.key_rows = {}
+        self.host_only = set()
+        self.invalidated = set()
+        self.ts[:] = 0
+        self.exec_ts[:] = np.iinfo(np.int32).min
+        self.kinds[:] = 0
+        self.valid[:] = False
+        self.keys_mod[:] = -1
+        for old_row in live:
+            row = self.count
+            self.count += 1
+            self.txn_ids.append(old_ids[old_row])
+            self.key_sets.append(old_keys[old_row])
+            self.exec_max.append(old_exec[old_row])
+            self.row_of[old_ids[old_row]] = row
+            self.ts[row] = old_ts[old_row]
+            self.exec_ts[row] = old_exec_ts[old_row]
+            self.kinds[row] = old_kinds[old_row]
+            # validity is RECOMPUTED, not copied: the old lane is overloaded
+            # (false for invalidated AND host_only rows), and a formerly
+            # host_only row whose key set shrank to <= MAXK must re-enter
+            # the device path -- copying would strand it invisible to both
+            # the kernel and the host_only supplement scan
+            self.valid[row] = old_row not in old_invalidated
+            if old_row in old_invalidated:
+                self.invalidated.add(row)
+            self._set_row_keys(row)   # demotes >MAXK rows to host_only
+            for k in old_keys[old_row]:
+                self._set_key_row_bit(k, row)
+        self._device = None
+        self._dirty_rows = set()
+        self.gen += 1
+        return True
+
     def update(self, txn_id: TxnId, key_set, status: CfkStatus,
                conflict_ts: Timestamp) -> None:
         key_set = frozenset(key_set)
@@ -185,7 +248,7 @@ class _NodeArena:
             Invariants.check_state(self.encoder.in_window(txn_id),
                                    "active txn %s outside encoder window",
                                    txn_id)
-            if self.count == self.cap:
+            if self.count == self.cap and not self.compact():
                 self._grow_host()
                 if self._device is not None:
                     from accord_tpu.ops.kernels import arena_grow
@@ -366,12 +429,13 @@ class _Item:
 
 
 class _Call:
-    __slots__ = ("packed", "items", "arena")
+    __slots__ = ("packed", "items", "arena", "gen")
 
     def __init__(self, packed, items, arena):
         self.packed = packed
         self.items = items
         self.arena = arena
+        self.gen = arena.gen
 
 
 class BatchDepsResolver(DepsResolver):
@@ -426,6 +490,11 @@ class BatchDepsResolver(DepsResolver):
         mine = {k for k in arena.key_sets[row]
                 if store.slice_ranges.contains_key(k)}
         arena.remove_keys(txn_id, mine)
+
+    def on_prune(self, store, txn_id: TxnId, keys) -> None:
+        arena = self._arenas.get(id(store.node))
+        if arena is not None:
+            arena.remove_keys(txn_id, keys)
 
     # -- async batched path (the hot path) ------------------------------------
     def enqueue_preaccept(self, store, txn_id, partial_txn, route,
@@ -553,8 +622,9 @@ class BatchDepsResolver(DepsResolver):
 
     def _harvest(self, call: _Call) -> None:
         import time as _time
+        stale = call.gen != call.arena.gen
         packed = None
-        if call.packed is not None:
+        if call.packed is not None and not stale:
             t0 = _time.perf_counter()
             packed = np.asarray(call.packed)
             self.harvest_stall_s += _time.perf_counter() - t0
@@ -562,6 +632,15 @@ class BatchDepsResolver(DepsResolver):
         results = []
         for item in call.items:
             store = item.store
+            if stale:
+                # the arena compacted while this call was in flight: its
+                # packed rows address the OLD row mapping -- answer from the
+                # host scan (rare; exact, floor-injected like the normal path)
+                raw = store.host_calculate_deps(item.txn_id, item.owned,
+                                                item.before)
+                results.append(store.inject_dep_floor(
+                    item.txn_id, item.owned, raw, item.before))
+                continue
             deps = self._decode_item(call.arena, item, packed)
             if store.range_txns:
                 deps = deps.union(store.host_range_deps(
