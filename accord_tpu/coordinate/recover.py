@@ -62,7 +62,11 @@ class Recover(Callback):
         self.result: AsyncResult = AsyncResult()
         self.topologies = node.topology_manager.with_unsynced_epochs(
             route, txn_id.epoch, txn_id.epoch)
-        self.topology = self.topologies.for_epoch(txn_id.epoch)
+        # a retired txn epoch (below the universal durability floor) is
+        # answered by the oldest retained topology (see
+        # TopologyManager.retire_below); replies resolve TRUNCATED
+        self.topology = self.topologies.for_epoch(
+            max(txn_id.epoch, self.topologies.oldest_epoch()))
         self.tracker = RecoveryTracker(self.topologies, txn.keys)
         self.oks: Dict[int, RecoverOk] = {}
         self._decided = False
