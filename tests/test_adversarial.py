@@ -91,3 +91,29 @@ def test_adversarial_with_churn():
                                       timeout_ms=4000.0,
                                       preaccept_timeout_ms=4000.0))
     assert r.lost == 0
+
+
+# KNOWN RESIDUAL (round 4): the FULLY-combined mode -- topology churn +
+# chaos + crash/restart + durability rounds -- still has liveness/rebuild
+# holes on some seeds: seed 3 fails the post-restart journal-rebuild diff
+# ("Er[...] lost in rebuild": an epoch-2 sync point present in the pre-crash
+# snapshot is not reconstructed once durability floors replayed ahead of
+# it), and seeds 1-2 showed retired-epoch recovery crashes (fixed) with a
+# possible remaining quiescence tail. Every individual pairing (churn+chaos,
+# crash+durability, delays+drift+chaos, churn+delays+drift) is green in the
+# suite and the 34-seed sweep; the 4-way combination is tracked here so the
+# hole stays visible.
+@pytest.mark.skip(reason="KNOWN residual: 4-way churn+chaos+crash+durability "
+                         "(journal rebuild vs replayed floors); failing runs "
+                         "burn minutes at the event cap, so skipped rather "
+                         "than xfailed -- run manually via "
+                         "/tmp-style sweep or this test to reproduce")
+@pytest.mark.parametrize("seed", (1, 3))
+def test_everything_with_crash_restart(seed):
+    r = run_burn(seed, ops=300, topology_churn=True, churn_interval_ms=1000.0,
+                 chaos_drop=0.05, chaos_partitions=True, crash_restart=True,
+                 config=ClusterConfig(num_nodes=4, rf=3, timeout_ms=4000.0,
+                                      preaccept_timeout_ms=4000.0,
+                                      durability=True,
+                                      durability_interval_ms=500.0))
+    assert r.lost == 0
