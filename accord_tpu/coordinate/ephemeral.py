@@ -42,6 +42,7 @@ class CoordinateEphemeralRead(Callback):
         self.latest_epoch = txn_id.epoch
         self.chases = 0
         self.executing = False
+        self.round = 0
 
     MAX_EPOCH_CHASES = 3
 
@@ -52,21 +53,27 @@ class CoordinateEphemeralRead(Callback):
         return self.result
 
     def _send_round(self) -> None:
+        # each deps round gets its own callback stamped with the round number
+        # so late replies/timeouts from a superseded round (after an epoch
+        # chase replaced the tracker) are never credited against the new
+        # QuorumTracker -- the same cross-round crediting hazard
+        # transaction.py's _ReadRoundCb guards
+        cb = _DepsRoundCb(self, self.round)
         for to in self.tracker.nodes():
             self.node.send(to, GetEphemeralReadDeps(self.txn_id, self.txn.keys),
-                           self)
+                           cb)
 
     # -- deps collection ------------------------------------------------------
-    def on_success(self, from_node, reply) -> None:
-        if self.result.done or self.executing:
+    def on_round_success(self, round_no, from_node, reply) -> None:
+        if self.result.done or self.executing or round_no != self.round:
             return
         self.oks.append(reply)
         self.latest_epoch = max(self.latest_epoch, reply.latest_epoch)
         if self.tracker.on_success(from_node) == RequestStatus.SUCCESS:
             self._quorum_reached()
 
-    def on_failure(self, from_node, failure) -> None:
-        if self.result.done or self.executing:
+    def on_round_failure(self, round_no, from_node, failure) -> None:
+        if self.result.done or self.executing or round_no != self.round:
             return
         if self.tracker.on_failure(from_node) == RequestStatus.FAILED:
             self.result.try_set_failure(
@@ -91,6 +98,7 @@ class CoordinateEphemeralRead(Callback):
 
             def rerun():
                 self.collected_epoch = target
+                self.round += 1  # invalidate the superseded round's callbacks
                 self.topologies = self.node.topology_manager \
                     .with_unsynced_epochs(self.route, target, target)
                 self.tracker = QuorumTracker(self.topologies, self.txn.keys)
@@ -116,6 +124,23 @@ class CoordinateEphemeralRead(Callback):
             node.with_epoch(epoch, start)
         else:
             start()
+
+
+class _DepsRoundCb(Callback):
+    """Round-stamped adapter: replies from a superseded deps round must not
+    credit the tracker of the round that replaced it."""
+
+    __slots__ = ("parent", "round_no")
+
+    def __init__(self, parent: CoordinateEphemeralRead, round_no: int):
+        self.parent = parent
+        self.round_no = round_no
+
+    def on_success(self, from_node, reply) -> None:
+        self.parent.on_round_success(self.round_no, from_node, reply)
+
+    def on_failure(self, from_node, failure) -> None:
+        self.parent.on_round_failure(self.round_no, from_node, failure)
 
 
 class _EphemeralExecute(Callback):

@@ -698,98 +698,33 @@ class MaybeRecover(Callback):
                 # the record (outcome universally durable + erased): mark our
                 # local records truncated too, so dependents drop their wait
                 # edges instead of probing forever
-                self._mark_local_truncated(self.participants)
+                from accord_tpu.messages.propagate import Propagate
+                self.node.receive_local(Propagate(
+                    Propagate.TRUNCATE, self.txn_id, self.participants))
             self.result.try_set_success(value)
 
-    def _mark_local_truncated(self, scope) -> None:
-        from accord_tpu.local import commands as _commands
-        from accord_tpu.local.status import Status as _S
-        for store in self.node.command_stores.all():
-            if not store.owns(scope):
-                continue
-            # create the record if absent: the engine (and any future waiter
-            # resurrecting the id) needs the terminal status to be LOCALLY
-            # visible, else it re-probes a cluster-wide truncation forever
-            cmd = store.command(self.txn_id)
-            if cmd.status.is_terminal or cmd.has_been(_S.APPLIED):
-                continue
-            if self.txn_id.kind.is_write \
-                    and not store.bootstrap_covers(self.txn_id, scope) \
-                    and store.current_owned().intersects(scope):
-                # a truncated WRITE this store never applied and no snapshot
-                # delivered: its data is missing a durable outcome no
-                # reachable replica still carries -- only a fresh bootstrap
-                # snapshot can repair it. Mark ONLY the currently-owned
-                # slice: gap-marking ranges the store merely lost would
-                # poison historical serving forever (nothing re-bootstraps
-                # a range the store no longer owns).
-                gap = _to_ranges(store.owned(scope)).intersection(
-                    store.current_owned())
-                store.mark_gap(gap)
-            cmd.status = _S.TRUNCATED
-            _commands.notify_listeners(store, cmd)
-            store.progress_log.clear(self.txn_id)
-
-    # -- Propagate (reference: messages/Propagate.java:64) -------------------
+    # -- Propagate (messages/propagate.py; reference: Propagate.java:64).
+    # Local application is a journaled LocalRequest: state repaired by a
+    # probe must survive a restart's journal replay.
     def _propagate_invalidate(self, merged: Optional[CheckStatusOk] = None) -> None:
-        from accord_tpu.local import commands
-        scope = self.participants
-        if merged is not None and merged.route is not None:
-            scope = merged.route.participants
-        for store in self.node.command_stores.all():
-            if store.owns(scope) or store.owns(self.participants):
-                commands.commit_invalidate(store, self.txn_id)
+        from accord_tpu.messages.propagate import Propagate
+        self.node.receive_local(Propagate(
+            Propagate.INVALIDATE, self.txn_id, self.participants, merged))
         self.result.try_set_success(Outcome.INVALIDATED)
 
     def _propagate_truncated(self, merged: CheckStatusOk) -> None:
-        """The outcome is durable cluster-wide but no reachable reply carries
-        it any more. Mark local records truncated (dependents drop the edge);
-        a local replica that never applied a truncated WRITE has a data gap --
-        its copy can only be repaired by a fresh bootstrap snapshot."""
-        scope = merged.route.participants if merged.route is not None \
-            else self.participants
-        self._mark_local_truncated(scope)
+        from accord_tpu.messages.propagate import Propagate
+        self.node.receive_local(Propagate(
+            Propagate.TRUNCATE, self.txn_id, self.participants, merged))
         self.result.try_set_success(Outcome.TRUNCATED)
 
     def _propagate_outcome(self, merged: CheckStatusOk) -> None:
-        """Apply a remotely-known outcome to our local stores. Writes in a
-        reply are the sender's slice, so each store only accepts replies whose
-        writes cover that store's slice of the participants."""
-        from accord_tpu.local import commands
-        applied_any = False
-        # the full participant set: self.participants may be only where a
-        # blocked dep was SEEN, and applying a store's slice partially while
-        # marking the command APPLIED would silently lose writes
-        scope = merged.route.participants if merged.route is not None \
-            else self.participants
-        # each reply's txn/writes are the SENDER's slice, but merge() unions
-        # them: the MERGED knowledge may cover a store no single reply does
-        # (common after topology churn re-shapes ownership)
-        for store in self.node.command_stores.all():
-            if not store.owns(scope):
-                continue
-            need = _to_ranges(store.owned(scope))
-            if merged.partial_txn is None or not merged.partial_txn.covers(need):
-                continue
-            w = merged.writes
-            if self.txn_id.kind.is_write:
-                # writes union from FEWER replies than partial_txn (STABLE
-                # replies carry txn but no writes): applying a narrower
-                # writes slice while marking APPLIED would silently lose
-                # writes for the uncovered keys
-                if w is None:
-                    continue
-                needed_keys = set(merged.partial_txn.keys.slice(need))
-                if not needed_keys <= set(w.keys):
-                    continue
-            partial = merged.partial_txn.slice(store.ranges, include_query=False)
-            deps = (merged.stable_deps or Deps.NONE).slice(store.ranges)
-            commands.apply(store, self.txn_id, merged.route,
-                           partial, merged.execute_at, deps,
-                           w.slice(store.ranges) if w is not None else None,
-                           merged.result)
-            applied_any = True
-        if applied_any:
+        """Apply a remotely-known outcome to our local stores; if no merged
+        reply covers our slices, fall back to a full Recover (re-executes)."""
+        from accord_tpu.messages.propagate import Propagate, covering_stores
+        if covering_stores(self.node, self.txn_id, self.participants, merged):
+            self.node.receive_local(Propagate(
+                Propagate.OUTCOME, self.txn_id, self.participants, merged))
             self.result.try_set_success(Outcome.APPLIED)
         else:
             # outcome exists but no reply covers us: recover (re-executes)

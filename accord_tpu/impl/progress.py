@@ -187,8 +187,71 @@ class ProgressEngine:
         if last is not None and now - last < self.stall_ms:
             return
         store._last_gap_heal_ms = now
-        from accord_tpu.local.bootstrap import Bootstrap
-        Bootstrap.run(self.node, store, self.node.epoch, gaps)
+        # repair gaps (missing data known universally applied) heal by union
+        # data repair -- a gap-checked bootstrap fetch deadlocks when every
+        # current replica is itself gapped; fresh-history gaps need the full
+        # ESP + snapshot acquisition
+        repair = gaps.intersection(store.repair_gaps)
+        if not repair.is_empty():
+            self._run_data_repair(store, repair)
+            gaps = gaps.difference(repair)
+        if not gaps.is_empty():
+            from accord_tpu.local.bootstrap import Bootstrap
+            Bootstrap.run(self.node, store, self.node.epoch, gaps)
+
+    def _run_data_repair(self, store, ranges) -> None:
+        """Union data repair: read every node's current data for `ranges`
+        unconditionally and merge. Complete when replies cover enough nodes
+        that at least one then-replica of every key is included: a write
+        below a truncation floor was applied at EVERY replica of its shard,
+        so any (num_nodes - min_rf + 1) nodes include one holder."""
+        from accord_tpu.messages.base import Callback
+        from accord_tpu.messages.fetch import DataRepairOk, DataRepairRead
+        node = self.node
+        topology = node.topology_manager.current()
+        all_nodes = sorted(set(topology.nodes()))
+        others = [n for n in all_nodes if n != node.id]
+        if not others:
+            store.fill_gap(ranges)
+            return
+        min_rf = min(len(s.nodes) for s in topology.shards)
+        need = max(1, len(all_nodes) - min_rf + 1 - 1)  # -1: self always holds
+        engine = self
+
+        class _Repair(Callback):
+            def __init__(self):
+                self.merged: Dict = {}
+                self.got = 0
+                self.answered = 0
+                self.done = False
+
+            def on_success(self, from_node, reply):
+                if self.done or not isinstance(reply, DataRepairOk):
+                    return
+                for key, entries in reply.data.items():
+                    self.merged.setdefault(key, set()).update(entries)
+                self.got += 1
+                self.answered += 1
+                self._maybe_finish()
+
+            def on_failure(self, from_node, failure):
+                if self.done:
+                    return
+                self.answered += 1
+                self._maybe_finish()
+
+            def _maybe_finish(self):
+                if self.got >= len(others) \
+                        or (self.answered >= len(others) and self.got >= need):
+                    self.done = True
+                    node.data_store.merge_entries(self.merged)
+                    store.fill_gap(ranges)
+                elif self.answered >= len(others):
+                    self.done = True  # not enough replies: next sweep retries
+
+        cb = _Repair()
+        for to in others:
+            node.send(to, DataRepairRead(ranges), cb)
 
     def _locally_resolved(self, entry: _Tracked) -> bool:
         """Done when every local store owning the participants has the command
@@ -207,7 +270,16 @@ class ProgressEngine:
             if cmd is not None and (cmd.has_been(Status.APPLIED)
                                     or cmd.status.is_terminal):
                 continue
-            if store.is_truncated(entry.txn_id, entry.participants):
+            # truncation is judged on the command's FULL participant set when
+            # known (its route), not the possibly-narrower set the entry was
+            # tracked under: commit/apply refuse on the route scope, so the
+            # resolver must finalize on the same scope or a half-floored
+            # record can neither apply nor resolve (the seed-13 endless
+            # probe->refuse wedge)
+            parts = entry.participants
+            if cmd is not None and cmd.route is not None:
+                parts = cmd.route.participants
+            if store.is_truncated(entry.txn_id, parts):
                 # below the truncation floor: the outcome is durable
                 # cluster-wide, and the txn will never individually finish
                 # here. A leftover record -- resurrected by a waiter, or a
@@ -218,16 +290,16 @@ class ProgressEngine:
                 if cmd is not None and cmd.status != Status.TRUNCATED:
                     from accord_tpu.local import commands as _commands
                     if entry.txn_id.kind.is_write and not store.bootstrap_covers(
-                            entry.txn_id, entry.participants):
+                            entry.txn_id, parts):
                         # a durable write this store never applied and no
                         # snapshot delivered: its data can only be repaired
                         # by a future bootstrap -- mark only the currently-
                         # owned slice (lost ranges are never re-bootstrapped,
                         # so their gap would poison historical serving)
-                        owned = store.owned(entry.participants)
+                        owned = store.owned(parts)
                         owned = owned if not isinstance(owned, Keys) \
                             else owned.to_ranges()
-                        store.mark_gap(owned.intersection(
+                        store.mark_repair_gap(owned.intersection(
                             store.current_owned()))
                     # ORDER MATTERS: status must be terminal BEFORE the
                     # notify/clear calls -- clear() re-enters this predicate

@@ -80,7 +80,9 @@ class Node:
         announce sync-complete to the cluster."""
         if self.topology_manager.has_epoch(topology.epoch):
             return
-        self.topology_manager.on_topology_update(topology)
+        # waiters fire only after store ownership below is applied (see
+        # TopologyManager.notify_epoch)
+        self.topology_manager.on_topology_update(topology, notify=False)
         if self.command_stores is None:
             kwargs = {}
             if self._store_factory is not None:
@@ -92,8 +94,9 @@ class Node:
                 progress_log_factory=self._progress_log_factory,
                 deps_resolver=self._deps_resolver, **kwargs)
         epoch = topology.epoch
-        self.command_stores.update_topology(topology) \
-            .on_success(lambda _: self._on_epoch_locally_synced(epoch)) \
+        result = self.command_stores.update_topology(topology)
+        self.topology_manager.notify_epoch(epoch)
+        result.on_success(lambda _: self._on_epoch_locally_synced(epoch)) \
             .on_failure(self.agent.on_uncaught_exception)
 
     def _on_epoch_locally_synced(self, epoch: int) -> None:
@@ -196,6 +199,19 @@ class Node:
             request.process(self, from_node, reply_context)
         except BaseException as e:  # noqa: BLE001 -- agent decides
             self.agent.on_uncaught_exception(e)
+
+    def receive_local(self, request) -> None:
+        """Ingress for LocalRequests (reference: Node.localRequest +
+        MessageType side-effect flagging): side-effecting local messages
+        (Propagate) must pass through the host's journal hook so a restart's
+        replay reconstructs the state they created. The sim cluster installs
+        `local_request_sink` to journal + round-trip them; without a sink
+        they process directly."""
+        sink = getattr(self, "local_request_sink", None)
+        if sink is not None:
+            sink(request)
+        else:
+            self.receive(request, self.id, None)
 
 
 class _ReliableSend:

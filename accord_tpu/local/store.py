@@ -97,6 +97,9 @@ class CommandStore:
         # for them -- dep elision + a missing snapshot would hand a fetcher
         # stale data. Cleared only when a bootstrap's snapshot merges.
         self.data_gaps: Ranges = Ranges.EMPTY
+        # subset of data_gaps healable by union data repair (see
+        # mark_repair_gap)
+        self.repair_gaps: Ranges = Ranges.EMPTY
         # bootstraps currently acquiring ranges for this store
         self.active_bootstraps: list = []
         # durability floors (reference: local/DurableBefore.java:39):
@@ -340,7 +343,12 @@ class CommandStore:
     def is_truncated(self, txn_id: TxnId, seekables: Seekables) -> bool:
         """Was this txn's local record truncated? (Any owned part below the
         truncation floor: below it every txn either applied durably or was
-        invalidated, and the record is gone either way.)"""
+        invalidated, and the record is gone either way.) Commit/apply refuse
+        on this over the ROUTE scope; the progress resolver finalizes on the
+        same scope (a mismatch -- refusing wide, resolving narrow -- left
+        half-floored records in an endless probe->refuse loop), and a probe
+        whose merged conclusion is TRUNCATED-with-outcome finalizes any
+        refused local copies via Propagate."""
         if self.truncated_before.is_empty():
             return False
         ts = txn_id.as_timestamp()
@@ -608,8 +616,21 @@ class CommandStore:
         self.data_gaps = self.data_gaps.union(ranges)
         self.progress_log.gap_marked()
 
+    def mark_repair_gap(self, ranges: Ranges) -> None:
+        """A gap whose missing data is UNIVERSALLY APPLIED (a truncated write
+        this store never applied): every then-replica's durable data store
+        holds it, so it heals by unconditional union data repair
+        (ProgressEngine._run_data_repair) rather than an ESP+snapshot
+        bootstrap -- whose gap-checked fetch deadlocks when every current
+        replica is itself gapped."""
+        if ranges.is_empty():
+            return
+        self.repair_gaps = self.repair_gaps.union(ranges)
+        self.mark_gap(ranges)
+
     def fill_gap(self, ranges: Ranges) -> None:
         self.data_gaps = self.data_gaps.difference(ranges)
+        self.repair_gaps = self.repair_gaps.difference(ranges)
 
     def has_gap(self, ranges: Ranges) -> bool:
         return self.data_gaps.intersects(ranges)

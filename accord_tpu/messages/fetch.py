@@ -53,6 +53,45 @@ class FetchData(Request):
         return f"FetchData({self.sync_id!r}, {self.ranges!r})"
 
 
+class DataRepairRead(Request):
+    """Unconditional data read for union repair: serve whatever this node's
+    durable data store currently holds for `ranges` -- no gap check, no
+    sync-point wait. Used to heal repair_gaps (missing data that is known
+    universally applied: every then-replica's data store holds it, and data
+    stores only grow, so the union over any set containing one then-replica
+    is complete). A gap-checked FetchData cannot heal these: when every
+    current replica is itself gapped they nack each other forever."""
+
+    def __init__(self, ranges: Ranges):
+        self.ranges = ranges
+        self.wait_for_epoch = 0
+
+    @property
+    def has_side_effects(self) -> bool:
+        return False
+
+    def process(self, node, from_node, reply_context) -> None:
+        data: Dict[object, Tuple] = {}
+        for key, entries in node.data_store.data.items():
+            if self.ranges.contains_key(key):
+                data[key] = tuple(entries)
+        node.reply(from_node, reply_context, DataRepairOk(self.ranges, data))
+
+    def __repr__(self):
+        return f"DataRepairRead({self.ranges!r})"
+
+
+class DataRepairOk(Reply):
+    __slots__ = ("ranges", "data")
+
+    def __init__(self, ranges: Ranges, data: Dict[object, Tuple]):
+        self.ranges = ranges
+        self.data = data
+
+    def __repr__(self):
+        return f"DataRepairOk(keys={len(self.data)})"
+
+
 class FetchNack(Reply):
     """Source cannot serve these ranges right now (its own bootstrap of them
     is incomplete); the fetcher escalates to another source."""

@@ -278,7 +278,12 @@ class BeginInvalidation(Request):
                 if cmd.promised > self.ballot:
                     return InvalidateNack(self.txn_id, cmd.promised, cmd.route)
                 cmd.promised = self.ballot
-            fp = cmd.is_(Status.PRE_ACCEPTED) and cmd.execute_at is not None \
+            # a fast-path vote is any witnessed executeAt == txnId, REGARDLESS
+            # of how far the replica has since advanced (reference:
+            # BeginInvalidation.java:69 acceptedFastPath) — narrowing to
+            # exactly PRE_ACCEPTED would under-count potential fast voters in
+            # propose_invalidate's safe-to-invalidate arithmetic
+            fp = cmd.execute_at is not None \
                 and cmd.execute_at == self.txn_id.as_timestamp()
             return InvalidateOk(self.txn_id, cmd.status, cmd.route, fp)
 
@@ -422,7 +427,9 @@ class CheckStatus(Request):
                                 and not cmd.status.is_terminal) else None
             return CheckStatusOk(self.txn_id, cmd.status, cmd.accepted_ballot,
                                  cmd.execute_at, cmd.route, cmd.txn, deps,
-                                 cmd.writes, cmd.result)
+                                 cmd.writes, cmd.result,
+                                 execute_at_decided=cmd.has_been(
+                                     Status.PRE_COMMITTED))
 
         def reduce_fn(a, b):
             return CheckStatusOk.merge(a, b)
@@ -437,12 +444,13 @@ class CheckStatus(Request):
 
 class CheckStatusOk(Reply):
     __slots__ = ("txn_id", "status", "accepted_ballot", "execute_at", "route",
-                 "partial_txn", "stable_deps", "writes", "result")
+                 "partial_txn", "stable_deps", "writes", "result",
+                 "execute_at_decided")
 
     def __init__(self, txn_id: TxnId, status: Status, accepted_ballot: Ballot,
                  execute_at: Optional[Timestamp], route: Optional[Route],
                  partial_txn: Optional[PartialTxn], stable_deps: Optional[Deps],
-                 writes, result):
+                 writes, result, execute_at_decided: bool = False):
         self.txn_id = txn_id
         self.status = status
         self.accepted_ballot = accepted_ballot
@@ -452,6 +460,11 @@ class CheckStatusOk(Reply):
         self.stable_deps = stable_deps  # deps only when STABLE+ (final)
         self.writes = writes
         self.result = result
+        # True iff execute_at comes from a record that DECIDED it
+        # (has_been(PRE_COMMITTED)); a PRE_ACCEPTED record's witnessed
+        # timestamp is a proposal, and treating it as an applyable outcome
+        # would apply a never-committed txn (the seed-3 split-brain)
+        self.execute_at_decided = execute_at_decided
 
     @staticmethod
     def merge(a: "CheckStatusOk", b: "CheckStatusOk") -> "CheckStatusOk":
@@ -472,12 +485,23 @@ class CheckStatusOk(Reply):
             writes = writes.union(lo.writes)  # per-store slices: union or lose keys
         elif writes is None:
             writes = lo.writes
+        # a DECIDED executeAt always wins over a witnessed proposal (decided
+        # values are unique by consensus, so two decided sides agree)
+        if hi.execute_at_decided:
+            execute_at, decided = hi.execute_at, True
+        elif lo.execute_at_decided:
+            execute_at, decided = lo.execute_at, True
+        else:
+            execute_at = hi.execute_at if hi.execute_at is not None \
+                else lo.execute_at
+            decided = False
         return CheckStatusOk(
             hi.txn_id, hi.status, hi.accepted_ballot,
-            hi.execute_at if hi.execute_at is not None else lo.execute_at,
+            execute_at,
             hi.route if hi.route is not None else lo.route,
             txn, deps, writes,
-            hi.result if hi.result is not None else lo.result)
+            hi.result if hi.result is not None else lo.result,
+            execute_at_decided=decided)
 
     # -- the decision-relevant slice of the reference's Known vector
     # (Status.Known, local/Status.java:126-133); only the two predicates the
@@ -491,9 +515,11 @@ class CheckStatusOk(Reply):
 
     @property
     def known_outcome(self) -> bool:
-        """An applyable outcome: executeAt + definition + (for writes) the
-        writes themselves."""
+        """An applyable outcome: a DECIDED executeAt + definition + (for
+        writes) the writes themselves. A witnessed-only executeAt (from a
+        PRE_ACCEPTED record) is a proposal, not an outcome."""
         return (self.partial_txn is not None and self.execute_at is not None
+                and self.execute_at_decided
                 and (not self.txn_id.kind.is_write or self.writes is not None))
 
     def __repr__(self):

@@ -98,15 +98,10 @@ class ExecPlane:
             while self.cap - self.count < n:
                 self._grow()
 
-    def _compact(self) -> bool:
-        """Rebuild the arena keeping only live rows: pending commands and
-        the deps their wait sets still reference (everything else is settled
-        history that can never gate again). Returns False when compaction
-        would not reclaim at least half the capacity -- the caller grows
-        instead. Rebuilding from the host wait-graph (the oracle) is exact:
-        edges, lanes and flags are re-derived from current command state."""
+    def _live_set(self) -> List[TxnId]:
+        """Pending commands plus every dep their wait sets still reference
+        (everything else is settled history that can never gate again)."""
         store = self.store
-        self._compacting = True
         live: List[TxnId] = []
         seen = set()
         for row in np.nonzero(self.pending[:self.count])[0].tolist():
@@ -123,9 +118,58 @@ class ExecPlane:
                     if dep not in seen:
                         seen.add(dep)
                         live.append(dep)
+        return live
+
+    def _compact(self) -> bool:
+        """Rebuild the arena keeping only live rows. Returns False when
+        compaction would not reclaim at least half the capacity -- the
+        caller grows instead. Rebuilding from the host wait-graph (the
+        oracle) is exact: edges, lanes and flags are re-derived from
+        current command state."""
+        self._compacting = True
+        live = self._live_set()
         if len(live) > self.cap // 2:
             self._compacting = False
             return False
+        self._rebuild(live)
+        self._compacting = False
+        return True
+
+    def _ensure_window(self, ts) -> None:
+        """Guard before encode(): executeAt hlc drifts past the encoder's
+        int32 window (~2^31 us, ~35 simulated minutes) on long-running
+        stores; re-base via a forced rebuild rather than raising inside
+        on_stable/on_status (the resolver guards this case the same way)."""
+        if ts is None or self.encoder is None or self.encoder.in_window(ts):
+            return
+        if self._compacting:
+            return  # the in-progress rebuild already re-bases
+        self._compacting = True
+        self._rebuild(self._live_set(), extra_base=ts)
+        self._compacting = False
+        # a live-set spread exceeding the int32 window (~35 simulated
+        # minutes between the oldest wedged executeAt and this one) cannot
+        # be encoded at any base: fail with a diagnostic rather than an
+        # opaque ValueError from the next encode()
+        Invariants.check_state(
+            self.encoder is None or self.encoder.in_window(ts),
+            "exec plane live window exceeds encoder range at %s "
+            "(oldest live executeAt is >2^31us behind; a dep is wedged)", ts)
+
+    def _rebuild(self, live: List[TxnId], extra_base=None) -> None:
+        """Reset and re-ingest `live`; always re-bases the encoder to the
+        minimum live executeAt (encodings are base-relative and the live
+        window drifts forward over the store's lifetime)."""
+        store = self.store
+        base = extra_base
+        for tid in live:
+            cmd = store.command_if_present(tid)
+            ts = cmd.execute_at if cmd is not None else None
+            for cand in (ts, tid.as_timestamp()):
+                if cand is not None and (base is None or cand < base):
+                    base = cand
+        if base is not None:
+            self.encoder = TimestampEncoder(base.epoch, base.hlc)
         self.count = 0
         self.row_of = {}
         self.txn_ids = []
@@ -153,8 +197,6 @@ class ExecPlane:
                     and not cmd.status.is_terminal \
                     and not cmd.has_been(Status.APPLIED):
                 self.on_stable(cmd)
-        self._compacting = False
-        return True
 
     def _grow(self) -> None:
         old_cap = self.cap
@@ -177,6 +219,7 @@ class ExecPlane:
         All rows are allocated BEFORE any write: _row can trigger a
         compaction that remaps every index, so an index held across an
         allocation would be stale."""
+        self._ensure_window(cmd.execute_at)
         wo = cmd.waiting_on
         dep_ids = tuple(wo.commit | wo.apply) if wo is not None else ()
         self._ensure_capacity(1 + len(dep_ids))
@@ -199,6 +242,8 @@ class ExecPlane:
     def on_status(self, cmd) -> None:
         """A command's status advanced (it may gate others): refresh its
         dep-side lanes."""
+        if cmd.known_execute_at:
+            self._ensure_window(cmd.execute_at)
         row = self.row_of.get(cmd.txn_id)
         if row is None:
             return

@@ -14,6 +14,7 @@ same two-long packing; here it is the *tensor* layout, not a memory trick.
 from __future__ import annotations
 
 import enum
+import os
 from typing import Optional, Tuple
 
 # Node ids are small ints (reference: Node.Id, local/Node.java:104).
@@ -129,6 +130,18 @@ class Timestamp:
             | (flags << _NODE_BITS) | node
         self._cmp = cmp
         self._hash = hash(cmp)
+
+    if os.environ.get("ACCORD_TPU_PARANOIA", "linear") == "superlinear":
+        # immutability enforced only at SUPERLINEAR paranoia (the test tier:
+        # instances are globally interned and shared across nodes/messages/
+        # dict keys, so a silent mutation would corrupt every structure
+        # holding one). This is the hottest constructor in the system -- the
+        # guard costs ~3x, so linear/production keep the guard-free path.
+        def __setattr__(self, name, value):
+            if hasattr(self, name):  # slots are write-once: init sets each once
+                raise AttributeError(
+                    f"{type(self).__name__} is immutable (tried to set {name})")
+            object.__setattr__(self, name, value)
 
     def __reduce__(self):
         # the wire boundary (sim/wire.py) pickles every message; interning

@@ -230,14 +230,18 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
                         fail(_T(f"coordinator n{nid} crashed"))
 
                 def restart():
-                    ready_us = cluster.restart_node(nid)
-
                     def verify():
-                        down.discard(nid)
                         cluster.verify_rebuild(nid, snapshot)
 
-                    # anchor on replay+catch-up completion, not a fixed lag
-                    cluster.queue.add(ready_us + 1_500_000, verify)
+                    # rebuild diff anchors on ACTUAL replay+catch-up issue
+                    # (epoch re-learning can outlast the scheduled replay
+                    # span); the NEXT crash waits for bootstrap completion
+                    # (on_healthy -> down cleared) -- overlapping full-range
+                    # gaps on multiple nodes livelock the fetch protocol
+                    cluster.restart_node(
+                        nid,
+                        on_ready=lambda: cluster.queue.add(1_500_000, verify),
+                        on_healthy=lambda: down.discard(nid))
 
                 cluster.queue.add(int(crash_down_ms * 1000), restart)
 

@@ -201,6 +201,7 @@ class Cluster:
         self._alive: Dict[NodeId, list] = {}
         self._node_rngs: Dict[NodeId, RandomSource] = {}
         self.journals: Dict[NodeId, List] = {}
+        self._crash_epoch: Dict[NodeId, int] = {}
         self.network.on_deliver = self._journal_record
         for node_id in range(1, self.config.num_nodes + 1):
             self.stores[node_id] = ListStore()
@@ -212,7 +213,15 @@ class Cluster:
         self._durability_should_stop = None
 
     def _journal_record(self, dst: NodeId, src: NodeId, payload: bytes) -> None:
-        self.journals[dst].append((src, payload))
+        # the recipient's delivered-epoch is journaled with each record: a
+        # replay must process each record against the topology knowledge the
+        # node had when it first processed it (a real journal persists this
+        # as record metadata). Without it, an epoch-2 record whose scope the
+        # node only owned via epoch 3 replays against epoch-2 ownership, no
+        # store intersects, and the record is silently dropped -- the round-4
+        # "lost in rebuild" residual.
+        self.journals[dst].append(
+            (src, payload, self.topology_service.delivered_epoch(dst)))
 
     def _build_node(self, node_id: NodeId) -> Node:
         from accord_tpu.sim.scheduler import NodeScheduler
@@ -270,6 +279,18 @@ class Cluster:
                 store.async_delay_us = (
                     lambda r=delay_rng,
                     m=self.config.store_delay_max_us: r.next_int(m))
+        def local_sink(req, nid=node_id, n=node):
+            # journal side-effecting LocalRequests (Propagate) exactly like
+            # delivered network messages, and process the wire round-tripped
+            # copy so live behavior matches a future replay
+            from accord_tpu.sim.network import ReplyContext
+            payload = wire.encode(req)
+            if getattr(req, "has_side_effects", True):
+                self.journals[nid].append(
+                    (nid, payload, self.topology_service.delivered_epoch(nid)))
+            n.receive(wire.decode(payload), nid, ReplyContext(nid, -1))
+
+        node.local_request_sink = local_sink
         self.nodes[node_id] = node
         self.network.register_node(node)
         return node
@@ -282,45 +303,114 @@ class Cluster:
         dead incarnation's coordinations once the node restarts). Returns a
         snapshot of its stable+ command state for the rebuild diff."""
         snapshot = self.stable_snapshot(node_id)
+        self._crash_epoch[node_id] = self.topology_service.delivered_epoch(node_id)
         self._alive[node_id][0] = False
         self.network.dead.add(node_id)
         self.network.purge_callbacks_of(node_id)
         return snapshot
 
-    def restart_node(self, node_id: NodeId) -> int:
+    def restart_node(self, node_id: NodeId, on_ready=None,
+                     on_healthy=None) -> int:
         """Bring the node back as a FRESH process: empty command state, the
         (durable) data store retained, topology re-learned from epoch 1, and
         the journal of side-effect messages replayed -- exactly a restart's
         recovery path. Replayed requests' replies address long-gone message
-        ids and are dropped by the reply demux. Returns the sim-microsecond
-        delay (from now) after which the replay AND catch-up fetch have been
-        issued -- callers anchor rebuild checks on it."""
+        ids and are dropped by the reply demux.
+
+        Each journal record is gated on the delivered-epoch it was recorded
+        under, so replay reconstructs the ownership conditions of the
+        original processing (records were journaled with monotonic epochs,
+        so gating preserves journal order). `on_ready` fires once the replay
+        has fully processed AND the catch-up fetch has been issued -- callers
+        anchor rebuild checks on it. `on_healthy` fires once the catch-up
+        bootstraps have COMPLETED (gaps filled, safe to read): overlapping
+        restarts leave multiple nodes with data gaps on the same ranges, and
+        gapped fetch sources nack each other into a cluster-wide bootstrap
+        livelock -- callers gate the NEXT crash on it, the way operators roll
+        one node at a time waiting for health. Returns the scheduled replay
+        span in sim microseconds (a lower bound on readiness; prefer the
+        callbacks)."""
         from accord_tpu.sim.network import ReplyContext
+        crash_epoch = self._crash_epoch.get(
+            node_id, self.topology_service.delivered_epoch(node_id))
         self.topology_service.reset_delivery(node_id)
         self.network.dead.discard(node_id)
         node = self._build_node(node_id)
         self.topology_service.request(node_id)  # re-pump epochs 2..latest
         replay_rng = self._node_rngs[node_id].fork()
-        delay = 1_000
-        for (src, payload) in list(self.journals[node_id]):
-            # spread the replay over a little sim time, preserving order
-            delay += 50 + replay_rng.next_int(50)
-            self.queue.add(delay, lambda s=src, p=payload: node.receive(
-                wire.decode(p), s, ReplyContext(s, -1)))
+        entries = list(self.journals[node_id])
+        remaining = [len(entries)]
 
         def catch_up():
             # writes applied by the cluster WHILE this node was down were
-            # never journaled here (its disk missed them): after the replay
-            # settles, refresh every store's currently-owned ranges with a
-            # bootstrap fetch from peers -- the standard restart catch-up
-            # sync (reference: markShardStale -> Bootstrap re-acquisition)
-            from accord_tpu.local.bootstrap import Bootstrap
+            # never journaled here (its disk missed them). The durable data
+            # store was retained and replay reconstructed everything
+            # delivered pre-crash, so the only missing state is the downtime
+            # window -- whose outcomes are GUARANTEED recoverable: the
+            # universal durability floor cannot advance past a down replica
+            # (QueryDurableBefore needs every node), so tier-B truncation
+            # never erases them. A local Barrier over the owned ranges waits
+            # for everything below a fresh sync point to apply HERE; records
+            # this node never saw are repaired by the progress engine's
+            # blocked-dep CheckStatus -> Propagate machinery (which carries
+            # writes). A snapshot re-bootstrap -- the prior design -- marked
+            # the FULL owned ranges as a data gap; concurrent restarts then
+            # nacked each other's fetches into a cluster-wide livelock.
+            from accord_tpu.coordinate.syncpoint import Barrier
+            owned = Ranges.EMPTY
             for s in node.command_stores.all():
-                owned = s.current_owned()
-                if not owned.is_empty():
-                    Bootstrap.run(node, s, max(2, node.epoch), owned)
+                owned = owned.union(s.current_owned())
+            if on_ready is not None:
+                on_ready()
+            if owned.is_empty():
+                if on_healthy is not None:
+                    on_healthy()
+                return
+            alive = self._alive[node_id]
+            attempt = [0]
 
-        self.queue.add(delay + 200_000, catch_up)
+            def run_barrier():
+                attempt[0] += 1
+                Barrier.local(node, owned) \
+                    .on_success(lambda _: (on_healthy() if on_healthy is not None
+                                           else None)) \
+                    .on_failure(retry)
+
+            def retry(_failure):
+                if not alive[0]:
+                    return  # crashed again; the next restart catches up
+                node.scheduler.once(min(400.0 * attempt[0], 3000.0),
+                                    run_barrier)
+
+            run_barrier()
+
+        def schedule_catch_up():
+            # replay done; also wait until every pre-crash epoch has been
+            # re-learned (the catch-up bootstrap's fresh sync point advances
+            # reject floors -- running it before the replayed records'
+            # epochs arrive would reject the very records being rebuilt)
+            node.with_epoch(crash_epoch,
+                            lambda: self.queue.add(200_000, catch_up))
+
+        def entry_done():
+            remaining[0] -= 1
+            if remaining[0] == 0:
+                schedule_catch_up()
+
+        delay = 1_000
+        for (src, payload, epoch_at) in entries:
+            # spread the replay over a little sim time, preserving order
+            delay += 50 + replay_rng.next_int(50)
+
+            def deliver(s=src, p=payload, e=epoch_at):
+                def run(_=None):
+                    node.receive(wire.decode(p), s, ReplyContext(s, -1))
+                    entry_done()
+                node.with_epoch(e, run)
+
+            self.queue.add(delay, deliver)
+        if not entries:
+            schedule_catch_up()
         if self._durability_should_stop is not None:
             # the rotation died with the old incarnation's scheduler:
             # restart it for the new one
@@ -333,15 +423,21 @@ class Cluster:
         return delay + 200_000
 
     def stable_snapshot(self, node_id: NodeId) -> dict:
-        """(store_id, txn_id) -> (status, execute_at) for stable+ commands:
-        what a journal replay must reconstruct (reference: Journal's
-        reflection diff of rebuilt commands)."""
+        """(store_id, txn_id) -> (status, execute_at, participants) for
+        stable+ commands: what a journal replay must reconstruct (reference:
+        Journal's reflection diff of rebuilt commands). Participants are
+        snapshotted so the rebuild diff can scope its truncation excusal to
+        the command's OWN keys, not any floored range of the store."""
         from accord_tpu.local.status import Status
         out = {}
         for s in self.nodes[node_id].command_stores.all():
             for txn_id, cmd in s.commands.items():
                 if cmd.status.is_stable:
-                    out[(s.store_id, txn_id)] = (cmd.status, cmd.execute_at)
+                    participants = cmd.route.participants \
+                        if cmd.route is not None else (
+                            cmd.txn.keys if cmd.txn is not None else s.ranges)
+                    out[(s.store_id, txn_id)] = (
+                        cmd.status, cmd.execute_at, participants)
         return out
 
     def verify_rebuild(self, node_id: NodeId, snapshot: dict) -> None:
@@ -349,18 +445,30 @@ class Cluster:
         with the SAME executeAt and at least stable status (or have been
         legitimately finished as terminal by floors that advanced since)."""
         stores = {s.store_id: s for s in self.nodes[node_id].command_stores.all()}
-        for (store_id, txn_id), (status, execute_at) in snapshot.items():
+        for (store_id, txn_id), (status, execute_at, participants) \
+                in snapshot.items():
             s = stores[store_id]
             cmd = s.command_if_present(txn_id)
-            if cmd is None or cmd.status.is_terminal:
-                ok = s.is_truncated(txn_id, s.ranges) or (
-                    cmd is not None and cmd.status.is_terminal)
-                assert ok, f"store {store_id}: {txn_id} lost in rebuild"
+            if cmd is not None and cmd.status.is_stable \
+                    and not cmd.status.is_terminal:
+                assert cmd.execute_at == execute_at, \
+                    f"store {store_id}: {txn_id} executeAt {cmd.execute_at} != {execute_at}"
                 continue
-            assert cmd.status.is_stable, \
-                f"store {store_id}: {txn_id} rebuilt only to {cmd.status.name}"
-            assert cmd.execute_at == execute_at, \
-                f"store {store_id}: {txn_id} executeAt {cmd.execute_at} != {execute_at}"
+            # missing / terminal / resurrected-empty records are fine iff the
+            # command's OWN participants reach below the truncation horizon
+            # (floors that advanced since legitimately finished it; an empty
+            # record may be a waiter's _init_waiting_on resurrection AFTER a
+            # legitimate truncation). Scoped to the snapshotted participants
+            # -- an unrelated floored range of the store must not excuse a
+            # genuinely lost command -- but with the same ANY-part
+            # granularity the engine's own truncation decisions use (cleanup
+            # erases on the store's txn SLICE; the resolver finalizes on the
+            # route scope).
+            ok = s.is_truncated(txn_id, participants) or (
+                cmd is not None and cmd.status.is_terminal)
+            assert ok, (f"store {store_id}: {txn_id} "
+                        + ("lost in rebuild" if cmd is None
+                           else f"rebuilt only to {cmd.status.name}"))
 
     def start_durability(self, should_stop=None) -> None:
         """Start background durability rotation on every node. The caller

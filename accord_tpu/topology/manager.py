@@ -39,7 +39,7 @@ class TopologyManager:
         self._awaiting: Dict[int, AsyncResult] = {}
 
     # -- updates -------------------------------------------------------------
-    def on_topology_update(self, topology: Topology) -> None:
+    def on_topology_update(self, topology: Topology, notify: bool = True) -> None:
         e = topology.epoch
         if e in self._epochs:
             return
@@ -52,9 +52,21 @@ class TopologyManager:
         if e == 1:
             st.synced = True
             st.ready.try_set_success(None)
-        waiter = self._awaiting.pop(e, None)
-        if waiter is not None:
-            waiter.try_set_success(topology)
+        if notify:
+            self.notify_epoch(e)
+
+    def notify_epoch(self, epoch: int) -> None:
+        """Fire await_epoch waiters. Node passes notify=False above and calls
+        this only AFTER CommandStores.update_topology has applied the epoch's
+        ownership: waiter callbacks run synchronously (and the sim scheduler's
+        now() is inline), so firing them from on_topology_update would process
+        epoch-gated messages against the PREVIOUS epoch's store ownership --
+        requests for newly-owned ranges would find no intersecting store and
+        be silently dropped (the round-4 'lost in rebuild' residual)."""
+        st = self._epochs.get(epoch)
+        waiter = self._awaiting.pop(epoch, None)
+        if waiter is not None and st is not None:
+            waiter.try_set_success(st.topology)
 
     def on_epoch_sync_complete(self, node: NodeId, epoch: int) -> None:
         """A node reports it has fully synced (applied all prior-epoch state

@@ -141,3 +141,41 @@ def test_truncated_everywhere_concludes_in_one_probe():
     assert f is None, f
     assert v in (Outcome.TRUNCATED, Outcome.APPLIED)
     assert extra == 0, f"{extra} extra probe rounds"
+
+
+def test_witnessed_timestamp_is_not_an_outcome():
+    """A PRE_ACCEPTED record's witnessed executeAt is a PROPOSAL: merging it
+    with a TRUNCATED sibling reply must NOT produce an 'applyable outcome'
+    (known_outcome), or the probe would APPLY a never-committed txn -- the
+    seed-3 split-brain where a preaccepted-then-rejected sync point was
+    invalidated on one shard and probe-applied on another."""
+    from accord_tpu.local.status import Status
+    from accord_tpu.messages.recover import CheckStatusOk
+    from accord_tpu.primitives.timestamp import Ballot
+
+    cluster = _mk_cluster()
+    node = cluster.nodes[1]
+    key = 500
+    txn = _write_txn(key, 21)
+    txn_id = node.next_txn_id(txn.kind, txn.domain)
+    route = node.compute_route(txn)
+    preaccepted = CheckStatusOk(
+        txn_id, Status.PRE_ACCEPTED, Ballot.ZERO,
+        txn_id.as_timestamp(),  # witnessed-only
+        route, txn.slice(route.participants.to_ranges(), False), None,
+        None, None, execute_at_decided=False)
+    truncated = CheckStatusOk(txn_id, Status.TRUNCATED, Ballot.ZERO,
+                              None, None, None, None, None, None)
+    merged = CheckStatusOk.merge(truncated, preaccepted)
+    assert merged.status == Status.TRUNCATED
+    assert not merged.known_outcome, \
+        "witnessed-only executeAt leaked into an applyable outcome"
+    # a DECIDED executeAt must win the merge over a witnessed one
+    decided = CheckStatusOk(
+        txn_id, Status.PRE_APPLIED, Ballot.ZERO,
+        txn_id.as_timestamp().with_next_hlc(), route,
+        txn.slice(route.participants.to_ranges(), False), None,
+        None, None, execute_at_decided=True)
+    merged2 = CheckStatusOk.merge(preaccepted, decided)
+    assert merged2.execute_at_decided
+    assert merged2.execute_at == decided.execute_at

@@ -64,6 +64,42 @@ def test_crashed_node_is_silent():
     assert c.stores[3].snapshot(9000) == (1, 2)  # caught up
 
 
+def test_replay_rebuilds_ranges_gained_in_later_epochs():
+    """Targeted regression for the round-4 'lost in rebuild' residual: a
+    command on a range the node only gained at epoch 2 must be rebuilt by
+    journal replay. Replay gates each record on the delivered-epoch it was
+    journaled under, and epoch waiters must fire only AFTER store ownership
+    is applied (TopologyManager.notify_epoch) -- otherwise the replayed
+    messages process against epoch-1 ownership, find no intersecting store,
+    and the command silently vanishes."""
+    from accord_tpu.sim.cluster import build_topology
+    from accord_tpu.topology.shard import Shard
+    from accord_tpu.topology.topology import Topology
+    from accord_tpu.primitives.keyspace import Range
+
+    cfg = ClusterConfig(num_nodes=3, rf=2, num_shards=2)
+    c = Cluster(29, cfg)
+    # epoch 1 (rf=2): shard0 [0,32768) -> nodes 1,2; shard1 [32768,65536)
+    # -> nodes 2,3. Epoch 2 moves shard1 to nodes 1,2: node 1 GAINS it.
+    t1 = c.current_topology()
+    assert 1 not in t1.shards[1].nodes
+    c.issue_topology(Topology(2, [Shard(Range(0, 32768), [1, 2]),
+                                  Shard(Range(32768, 65536), [1, 2])]))
+    c.drain()
+    c.check_no_failures()
+    # a write on the gained range, witnessed by node 1 at epoch 2
+    r = c.nodes[1].coordinate(write_txn([40000], 1))
+    c.drain()
+    assert r.done and r.failure is None, r.failure
+    snapshot = c.crash_node(1)
+    assert any(txn_id.epoch >= 2 for (_, txn_id) in snapshot), \
+        "scenario not exercised: no epoch-2+ command snapshotted"
+    c.restart_node(1)
+    c.drain()
+    c.check_no_failures()
+    c.verify_rebuild(1, snapshot)
+
+
 @pytest.mark.parametrize("seed", (1, 9, 13))
 def test_crash_restart_burn(seed):
     """One crash+restart per node mid-burn (staggered): converges, verifies
