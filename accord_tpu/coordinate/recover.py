@@ -668,9 +668,9 @@ class MaybeRecover(Callback):
             return
         if merged.route is not None \
                 and not merged.route.covering().contains_ranges(
-                    _to_ranges(self.participants)) \
-                and not _to_ranges(self.participants).contains_ranges(
-                    _to_ranges(merged.route.participants)):
+                    self.participants.to_ranges()) \
+                and not self.participants.to_ranges().contains_ranges(
+                    merged.route.participants.to_ranges()):
             # learn the full participant set, then retry with the full route
             # -- but ONLY if the route actually adds participants we have not
             # probed, else this recurses on itself forever (a partially-known
@@ -736,9 +736,3 @@ class MaybeRecover(Callback):
             else:
                 self.result.try_set_failure(Exhausted(
                     f"propagate {self.txn_id}: no covering outcome"))
-
-
-def _to_ranges(seekables: Seekables) -> Ranges:
-    if isinstance(seekables, Ranges):
-        return seekables
-    return seekables.to_ranges()

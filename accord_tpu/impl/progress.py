@@ -201,22 +201,22 @@ class ProgressEngine:
 
     def _run_data_repair(self, store, ranges) -> None:
         """Union data repair: read every node's current data for `ranges`
-        unconditionally and merge. Complete when replies cover enough nodes
-        that at least one then-replica of every key is included: a write
-        below a truncation floor was applied at EVERY replica of its shard,
-        so any (num_nodes - min_rf + 1) nodes include one holder."""
+        unconditionally and merge. Completes only when EVERY other node
+        replied: a repair-gap write was applied at every replica of its
+        shard at the epoch its durability floor advanced, and data stores
+        only grow, so the union over all nodes is guaranteed to contain it
+        -- but no smaller reply set is safe under topology churn (the
+        then-replica set is unknowable from the current topology, so any
+        partial-quorum bound can complete with zero holders). A missing
+        reply just retries on the next sweep (the gap stays marked)."""
         from accord_tpu.messages.base import Callback
         from accord_tpu.messages.fetch import DataRepairOk, DataRepairRead
         node = self.node
         topology = node.topology_manager.current()
-        all_nodes = sorted(set(topology.nodes()))
-        others = [n for n in all_nodes if n != node.id]
+        others = sorted(set(topology.nodes()) - {node.id})
         if not others:
             store.fill_gap(ranges)
             return
-        min_rf = min(len(s.nodes) for s in topology.shards)
-        need = max(1, len(all_nodes) - min_rf + 1 - 1)  # -1: self always holds
-        engine = self
 
         class _Repair(Callback):
             def __init__(self):
@@ -241,13 +241,12 @@ class ProgressEngine:
                 self._maybe_finish()
 
             def _maybe_finish(self):
-                if self.got >= len(others) \
-                        or (self.answered >= len(others) and self.got >= need):
+                if self.got >= len(others):
                     self.done = True
                     node.data_store.merge_entries(self.merged)
                     store.fill_gap(ranges)
                 elif self.answered >= len(others):
-                    self.done = True  # not enough replies: next sweep retries
+                    self.done = True  # unreachable node(s): next sweep retries
 
         cb = _Repair()
         for to in others:
@@ -296,11 +295,9 @@ class ProgressEngine:
                         # by a future bootstrap -- mark only the currently-
                         # owned slice (lost ranges are never re-bootstrapped,
                         # so their gap would poison historical serving)
-                        owned = store.owned(parts)
-                        owned = owned if not isinstance(owned, Keys) \
-                            else owned.to_ranges()
-                        store.mark_repair_gap(owned.intersection(
-                            store.current_owned()))
+                        store.mark_repair_gap(
+                            store.owned(parts).to_ranges().intersection(
+                                store.current_owned()))
                     # ORDER MATTERS: status must be terminal BEFORE the
                     # notify/clear calls -- clear() re-enters this predicate
                     # for the same entry, and only the terminal status makes

@@ -19,12 +19,6 @@ from accord_tpu.primitives.keyspace import Ranges, Seekables
 from accord_tpu.primitives.timestamp import TxnId
 
 
-def _to_ranges(seekables: Seekables) -> Ranges:
-    if isinstance(seekables, Ranges):
-        return seekables
-    return seekables.to_ranges()
-
-
 def _scope(merged, participants) -> Seekables:
     if merged is not None and merged.route is not None:
         return merged.route.participants
@@ -41,7 +35,7 @@ def covering_stores(node, txn_id: TxnId, participants, merged) -> List:
     for store in node.command_stores.all():
         if not store.owns(scope):
             continue
-        need = _to_ranges(store.owned(scope))
+        need = store.owned(scope).to_ranges()
         if merged.partial_txn is None or not merged.partial_txn.covers(need):
             continue
         w = merged.writes
@@ -104,7 +98,7 @@ def mark_local_truncated(node, txn_id: TxnId, scope) -> None:
             # delivered: mark ONLY the currently-owned slice (gap-marking
             # ranges the store merely lost would poison historical serving
             # forever -- nothing repairs a range the store no longer owns)
-            gap = _to_ranges(store.owned(scope)).intersection(
+            gap = store.owned(scope).to_ranges().intersection(
                 store.current_owned())
             store.mark_repair_gap(gap)
         cmd.status = _S.TRUNCATED

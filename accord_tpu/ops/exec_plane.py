@@ -147,14 +147,20 @@ class ExecPlane:
         self._compacting = True
         self._rebuild(self._live_set(), extra_base=ts)
         self._compacting = False
-        # a live-set spread exceeding the int32 window (~35 simulated
-        # minutes between the oldest wedged executeAt and this one) cannot
-        # be encoded at any base: fail with a diagnostic rather than an
-        # opaque ValueError from the next encode()
+
+    def _encode(self, ts):
+        """All hook-path encodes go through here: a compaction triggered by
+        _ensure_capacity can re-base the encoder AFTER _ensure_window ran
+        (the incoming command is not yet in the live set), so the window is
+        re-verified at the encode itself. A live-set spread exceeding the
+        int32 window (~35 simulated minutes between the oldest wedged
+        executeAt and this one) cannot be encoded at any base: fail with a
+        diagnostic rather than an opaque ValueError."""
         Invariants.check_state(
-            self.encoder is None or self.encoder.in_window(ts),
+            self.encoder is not None and self.encoder.in_window(ts),
             "exec plane live window exceeds encoder range at %s "
             "(oldest live executeAt is >2^31us behind; a dep is wedged)", ts)
+        return self.encoder.encode([ts])[0]
 
     def _rebuild(self, live: List[TxnId], extra_base=None) -> None:
         """Reset and re-ingest `live`; always re-bases the encoder to the
@@ -190,7 +196,7 @@ class ExecPlane:
                 self.applied[row] = True
                 continue
             if cmd.known_execute_at and cmd.execute_at is not None:
-                self.exec_ts[row] = self.encoder.encode([cmd.execute_at])[0]
+                self.exec_ts[row] = self._encode(cmd.execute_at)
         for tid in live:
             cmd = store.command_if_present(tid)
             if cmd is not None and cmd.has_been(Status.STABLE) \
@@ -229,7 +235,7 @@ class ExecPlane:
         row = self.row_of[cmd.txn_id]
         self.awaits_all[row] = cmd.txn_id.kind.awaits_only_deps
         if cmd.execute_at is not None:
-            self.exec_ts[row] = self.encoder.encode([cmd.execute_at])[0]
+            self.exec_ts[row] = self._encode(cmd.execute_at)
         self.adj[row] = 0
         for dep_id in dep_ids:
             d = self.row_of[dep_id]
@@ -248,7 +254,7 @@ class ExecPlane:
         if row is None:
             return
         if cmd.known_execute_at and cmd.execute_at is not None:
-            self.exec_ts[row] = self.encoder.encode([cmd.execute_at])[0]
+            self.exec_ts[row] = self._encode(cmd.execute_at)
         if cmd.has_been(Status.APPLIED) or cmd.status.is_terminal:
             self.applied[row] = True
             self.pending[row] = False
