@@ -572,12 +572,18 @@ class BatchDepsResolver(DepsResolver):
         for i, chunk in enumerate(subj_keys):
             mods = sorted({k % self.num_buckets for k in chunk})
             sk[i, :len(mods)] = mods
-        act_bm, act_ts, _, act_kinds, act_valid = arena.device_arrays()
-        return deps_resolve(
-            jnp.asarray(sk),
+        return self._run_kernel(
+            arena, jnp.asarray(sk),
             jnp.asarray(pad_to(arena.encoder.encode(subj_before), padded)),
-            jnp.asarray(pad_to(np.asarray(subj_kinds, np.int32), padded)),
-            act_bm, act_ts, act_kinds, act_valid, self._table)
+            jnp.asarray(pad_to(np.asarray(subj_kinds, np.int32), padded)))
+
+    def _run_kernel(self, arena: "_NodeArena", sk, sb, sknd):
+        """The fused kernel call; ShardedBatchDepsResolver overrides this to
+        run the same computation sharded over a device mesh."""
+        from accord_tpu.ops.kernels import deps_resolve
+        act_bm, act_ts, _, act_kinds, act_valid = arena.device_arrays()
+        return deps_resolve(sk, sb, sknd,
+                            act_bm, act_ts, act_kinds, act_valid, self._table)
 
     def _decode_item(self, arena: _NodeArena, item: _Item, packed) -> Deps:
         """Recover one subject's exact key-domain deps from the bit-packed
@@ -739,3 +745,43 @@ class BatchDepsResolver(DepsResolver):
             else:
                 out.append((False, None))  # bucket collision: host decides
         return out
+
+
+class ShardedBatchDepsResolver(BatchDepsResolver):
+    """BatchDepsResolver whose fused deps kernel runs SHARDED over a device
+    mesh: arena rows over the 'data' axis, key buckets over 'model' (the
+    overlap contraction psums across it) -- the reference's intra-node scale
+    dimension (CommandStores range-splitting, local/CommandStores.java:79)
+    mapped onto chips. Everything else -- arena maintenance, async pipeline,
+    exact per-key decode -- is inherited unchanged, so host/single-device/
+    sharded answers are differentially comparable.
+
+    The mesh jit's in_shardings reshard the arena arrays on entry each call
+    (the arena keeps holding the single-device arrays its scatters produce).
+    On a virtual CPU mesh that cost is noise; a real multi-chip deployment
+    would additionally give the scatter/grow ops matching out_shardings so
+    the arrays LIVE sharded and the per-call movement is dirty rows only."""
+
+    def __init__(self, mesh=None, num_buckets: int = 256,
+                 initial_cap: int = 4096):
+        super().__init__(num_buckets, initial_cap)
+        from accord_tpu.parallel.mesh import make_mesh
+        self.mesh = mesh if mesh is not None else make_mesh()
+        data = self.mesh.shape["data"]
+        model = self.mesh.shape["model"]
+        # both contracts survive arena doubling
+        Invariants.check_argument(
+            initial_cap % (32 * data) == 0,
+            "arena cap %s not divisible by 32*data(%s)", initial_cap, data)
+        Invariants.check_argument(
+            num_buckets % model == 0,
+            "num_buckets %s not divisible by model(%s)", num_buckets, model)
+
+    def _run_kernel(self, arena: _NodeArena, sk, sb, sknd):
+        # sharded_deps_resolve is lru_cached by mesh: every resolver (one
+        # per node in a burn) shares one compiled kernel
+        from accord_tpu.parallel.mesh import sharded_deps_resolve
+        kern = sharded_deps_resolve(self.mesh)
+        act_bm, act_ts, _, act_kinds, act_valid = arena.device_arrays()
+        return kern(sk, sb, sknd,
+                    act_bm, act_ts, act_kinds, act_valid, self._table)

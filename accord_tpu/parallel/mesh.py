@@ -24,7 +24,10 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.shard_map import shard_map
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover -- older jax
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -120,6 +123,61 @@ def sharded_deps_step(mesh: Mesh, closure_iters: int = 8):
                    out_shardings=(NamedSharding(mesh, P("data", None)), vec_sharding))
 
 
+@functools.lru_cache(maxsize=8)
+def sharded_deps_resolve(mesh: Mesh):
+    """Mesh-sharded twin of ops.kernels.deps_resolve -- THE production hot
+    kernel, not a demo: arena rows sharded over 'data' (each device holds a
+    block of the node's active set), key buckets over 'model' (the overlap
+    contraction psums across it). The packed u32[B, cap/32] result comes
+    back with its lane dimension sharded over 'data'; lane order equals row
+    order because every data block's capacity is a multiple of 32.
+
+    Contracts (enforced by ShardedBatchDepsResolver): cap % (32 * data) == 0
+    and num_buckets % model == 0 -- both preserved by arena doubling."""
+    from accord_tpu.ops.kernels import _lex_before
+
+    def run(subj_keys, subj_before, subj_kinds,
+            act_bm, act_ts, act_kinds, act_valid, table):
+        def part(sk, sb, sknd, bm, ts, kinds, valid, tbl):
+            # bm: [cap_local, K_local]; subject one-hot restricted to the
+            # LOCAL bucket slice so the contraction psums over 'model'
+            k_local = bm.shape[1]
+            base = jax.lax.axis_index("model") * k_local
+            local_buckets = base + jnp.arange(k_local, dtype=jnp.int32)
+            onehot = (sk[:, :, None] == local_buckets[None, None, :]) \
+                & (sk >= 0)[:, :, None]
+            subj_bm = onehot.any(axis=1).astype(jnp.bfloat16)
+            partial = jax.lax.dot_general(
+                subj_bm, bm.astype(jnp.bfloat16),
+                (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+            overlap = jax.lax.psum(partial, "model") > 0.5
+            witness = tbl[sknd[:, None], kinds[None, :]] == 1
+            before = _lex_before(ts[None, :, :], sb[:, None, :])
+            m = overlap & witness & before & valid[None, :]
+            b, a = m.shape
+            weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+            return jnp.sum(m.reshape(b, a // 32, 32).astype(jnp.uint32)
+                           * weights[None, None, :], axis=-1, dtype=jnp.uint32)
+
+        return shard_map(
+            part, mesh=mesh,
+            in_specs=(P(None, None), P(None, None), P(None),
+                      P("data", "model"), P("data", None), P("data"),
+                      P("data"), P(None, None)),
+            out_specs=P(None, "data"),
+        )(subj_keys, subj_before, subj_kinds,
+          act_bm, act_ts, act_kinds, act_valid, table)
+
+    rep2 = NamedSharding(mesh, P(None, None))
+    rep1 = NamedSharding(mesh, P(None))
+    return jax.jit(run, in_shardings=(
+        rep2, rep2, rep1,
+        NamedSharding(mesh, P("data", "model")),
+        NamedSharding(mesh, P("data", None)),
+        NamedSharding(mesh, P("data")), NamedSharding(mesh, P("data")),
+        rep2), out_shardings=NamedSharding(mesh, P(None, "data")))
+
+
 def example_batch(n: int = 64, k: int = 256, seed: int = 0):
     """Deterministic example inputs for compile checks and dry runs."""
     rng = np.random.default_rng(seed)
@@ -130,3 +188,27 @@ def example_batch(n: int = 64, k: int = 256, seed: int = 0):
     kinds = rng.integers(0, 2, n).astype(np.int32)  # READ/WRITE mix
     from accord_tpu.ops.encoding import WITNESS_TABLE
     return bitmaps, ts, kinds, WITNESS_TABLE.copy()
+
+
+def example_resolve_batch(cap: int = 512, k: int = 256, b: int = 16,
+                          maxk: int = 16, seed: int = 0):
+    """Deterministic random inputs in deps_resolve's exact signature shape
+    (subjects as -1-padded bucket indices, 3-lane int32 timestamps, arena
+    lanes) -- shared by the dry-run and the sharded-vs-single differential
+    tests so the invariants live in one place."""
+    from accord_tpu.ops.encoding import WITNESS_TABLE
+    rng = np.random.default_rng(seed)
+    sk = np.where(rng.random((b, maxk)) < 0.4,
+                  rng.integers(0, k, (b, maxk)), -1).astype(np.int32)
+    sb = np.stack([np.zeros(b, np.int32),
+                   rng.integers(1000, 100_000, b).astype(np.int32),
+                   rng.integers(0, 100, b).astype(np.int32)], 1)
+    sknd = rng.integers(0, 5, b).astype(np.int32)
+    act_bm = (rng.random((cap, k)) < 0.05).astype(np.float32)
+    act_ts = np.stack([np.zeros(cap, np.int32),
+                       rng.integers(0, 90_000, cap).astype(np.int32),
+                       rng.integers(0, 100, cap).astype(np.int32)], 1)
+    act_kinds = rng.integers(0, 5, cap).astype(np.int32)
+    act_valid = rng.random(cap) < 0.9
+    return (sk, sb, sknd, act_bm, act_ts, act_kinds, act_valid,
+            WITNESS_TABLE.copy())
