@@ -233,6 +233,9 @@ class ProgressLog(abc.ABC):
     def durable(self, command) -> None: ...
     def waiting(self, blocked_by: TxnId, blocked_until, participants) -> None: ...
     def clear(self, txn_id: TxnId) -> None: ...
+    def informed_of_txn(self, command) -> None:
+        """A peer informed the home shard this txn exists (reference:
+        InformOfTxnId -> Commands.informHome): take liveness ownership."""
     def gap_marked(self) -> None:
         """The store marked a data gap; an impl may schedule self-healing
         (the reference's Agent.onStale is the analogous host cue)."""

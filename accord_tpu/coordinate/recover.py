@@ -712,16 +712,38 @@ class MaybeRecover(Callback):
             Propagate.INVALIDATE, self.txn_id, self.participants, merged))
         self.result.try_set_success(Outcome.INVALIDATED)
 
+    def _inform_home_durable(self, merged: CheckStatusOk) -> None:
+        """The probe discovered a durable outcome: forward that knowledge to
+        the home shard so its engine stops recovery-driving (reference:
+        MaybeRecover.java:109 sends InformDurable to the home shard nodes)."""
+        from accord_tpu.local.status import Durability
+        from accord_tpu.messages.inform import InformHomeDurable
+        if merged.route is None or merged.durability < Durability.MAJORITY:
+            return
+        try:
+            shard = self.node.topology_manager.current().shard_for_key(
+                merged.route.home_key)
+        except Exception:
+            return  # home range not in the current topology view
+        for to in shard.nodes:
+            if to != self.node.id:
+                self.node.counters["informs_home_durable_sent"] += 1
+                self.node.send(to, InformHomeDurable(
+                    self.txn_id, merged.route, merged.execute_at,
+                    merged.durability))
+
     def _propagate_truncated(self, merged: CheckStatusOk) -> None:
         from accord_tpu.messages.propagate import Propagate
         self.node.receive_local(Propagate(
             Propagate.TRUNCATE, self.txn_id, self.participants, merged))
+        self._inform_home_durable(merged)
         self.result.try_set_success(Outcome.TRUNCATED)
 
     def _propagate_outcome(self, merged: CheckStatusOk) -> None:
         """Apply a remotely-known outcome to our local stores; if no merged
         reply covers our slices, fall back to a full Recover (re-executes)."""
         from accord_tpu.messages.propagate import Propagate, covering_stores
+        self._inform_home_durable(merged)
         if covering_stores(self.node, self.txn_id, self.participants, merged):
             self.node.receive_local(Propagate(
                 Propagate.OUTCOME, self.txn_id, self.participants, merged))

@@ -478,6 +478,7 @@ class _ApplyRound(Callback):
         self.tracker = AppliedTracker(parent.topologies, parent.txn.keys)
         self.acked: set = set()
         self.attempts: Dict[int, int] = {}
+        self._informed = False
 
     def _message(self) -> Apply:
         p = self.parent
@@ -491,10 +492,27 @@ class _ApplyRound(Callback):
 
     def on_success(self, from_node, reply) -> None:
         self.acked.add(from_node)
-        if self.tracker.on_success(from_node) == RequestStatus.SUCCESS \
-                and self.on_applied is not None:
-            cb, self.on_applied = self.on_applied, None
-            cb()
+        if self.tracker.on_success(from_node) == RequestStatus.SUCCESS:
+            self._inform_durable()
+            if self.on_applied is not None:
+                cb, self.on_applied = self.on_applied, None
+                cb()
+
+    def _inform_durable(self) -> None:
+        """Applied quorum reached on every shard: broadcast majority-
+        durability so progress engines treat the txn as fetch-only work
+        (reference: Persist.java:88 sends InformDurable(Majority) to every
+        node of the topologies)."""
+        if self._informed:
+            return
+        self._informed = True
+        from accord_tpu.local.status import Durability
+        from accord_tpu.messages.inform import InformDurable
+        p = self.parent
+        for to in self.tracker.nodes():
+            p.node.counters["informs_durable_sent"] += 1
+            p.node.send(to, InformDurable(p.txn_id, p.route, p.execute_at,
+                                          Durability.MAJORITY))
 
     def on_failure(self, from_node, failure) -> None:
         if from_node in self.acked:

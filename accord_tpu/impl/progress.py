@@ -30,9 +30,10 @@ from accord_tpu.primitives.timestamp import TxnId
 
 class _Tracked:
     __slots__ = ("txn_id", "participants", "last_status", "last_change_ms",
-                 "attempts", "next_attempt_ms", "in_flight")
+                 "attempts", "next_attempt_ms", "in_flight", "home", "home_key")
 
-    def __init__(self, txn_id: TxnId, participants, status: Status, now_ms: float):
+    def __init__(self, txn_id: TxnId, participants, status: Status, now_ms: float,
+                 home: bool = True, home_key=None):
         self.txn_id = txn_id
         self.participants = participants
         self.last_status = status
@@ -40,15 +41,28 @@ class _Tracked:
         self.attempts = 0
         self.next_attempt_ms = 0.0
         self.in_flight = False
+        # home-shard ownership (reference ProgressShard.Home vs NonHome):
+        # home entries drive recovery at full cadence; non-home entries defer
+        # and first INFORM the home shard instead of probing themselves
+        self.home = home
+        self.home_key = home_key
 
 
 class ProgressEngine:
     def __init__(self, node=None, interval_ms: float = 250.0,
-                 stall_ms: float = 1500.0):
+                 stall_ms: float = 1500.0, home_defer: float = 3.0,
+                 inform_home: bool = True):
         self.node = None
         self.rng = None
         self.interval_ms = interval_ms
         self.stall_ms = stall_ms
+        # non-home entries wait home_defer x stall before acting at all, and
+        # their first action is InformOfTxnId to the home shard, not a probe
+        # (reference: SimpleProgressLog NonHomeState.StillUnsafe ->
+        # InformHomeOfTxn); home_defer=1.0 + inform_home=False restores
+        # every-replica-probes behavior (the gossip test compares the two)
+        self.home_defer = home_defer
+        self.inform_home = inform_home
         self.tracked: Dict[TxnId, _Tracked] = {}
         self._scheduled = False
         if node is not None:
@@ -65,25 +79,43 @@ class ProgressEngine:
 
     # -- tracking ------------------------------------------------------------
     def track(self, txn_id: TxnId, participants: Optional[Seekables],
-              status: Status) -> None:
+              status: Status, home: bool = True, home_key=None) -> None:
         now = self.node.now_millis()
         entry = self.tracked.get(txn_id)
         if entry is None:
             if participants is None:
                 return  # nowhere to address a probe yet
-            entry = _Tracked(txn_id, participants, status, now)
-            entry.next_attempt_ms = now + self.stall_ms + self._jitter()
+            entry = _Tracked(txn_id, participants, status, now, home, home_key)
+            entry.next_attempt_ms = now + self._stall(entry) + self._jitter()
             self.tracked[txn_id] = entry
         else:
             if participants is not None:
                 entry.participants = participants
+            if home and not entry.home:
+                # another store here owns the home key: promote, and pull the
+                # deferred non-home timer back to home cadence (the first
+                # recovery action must not inherit the 3x defer)
+                entry.home = True
+                entry.next_attempt_ms = min(
+                    entry.next_attempt_ms,
+                    now + self.stall_ms + self._jitter())
+            if home_key is not None and entry.home_key is None:
+                entry.home_key = home_key
             if status > entry.last_status:
                 # progress: reset the stall clock
                 entry.last_status = status
                 entry.last_change_ms = now
                 entry.attempts = 0
-                entry.next_attempt_ms = now + self.stall_ms + self._jitter()
+                entry.next_attempt_ms = now + self._stall(entry) + self._jitter()
         self._ensure_scheduled()
+
+    def _stall(self, entry: _Tracked) -> float:
+        # the defer applies only to non-home UNDECIDED entries (the orphaned-
+        # preaccept net): for decided txns every replica must fetch its own
+        # outcome regardless, so deferring would only slow straggler repair
+        if entry.home or entry.last_status.is_decided:
+            return self.stall_ms
+        return self.stall_ms * self.home_defer
 
     def clear(self, txn_id: TxnId) -> None:
         """A store reports the txn locally finished. The engine is node-wide
@@ -319,20 +351,69 @@ class ProgressEngine:
             return False
         return True
 
+    def _known_durability(self, entry: _Tracked):
+        """Max durability any local store records for this txn (fed by the
+        persist path's InformDurable broadcast and by probe gossip)."""
+        from accord_tpu.local.status import Durability
+        best = Durability.NOT_DURABLE
+        for store in self.node.command_stores.all():
+            cmd = store.command_if_present(entry.txn_id)
+            if cmd is not None and cmd.durability > best:
+                best = cmd.durability
+        return best
+
     def _attempt(self, entry: _Tracked, now: float) -> None:
         from accord_tpu.coordinate.recover import MaybeRecover
+        from accord_tpu.local.status import Durability
         entry.in_flight = True
         entry.attempts += 1
-        backoff = self.stall_ms * (2 ** min(entry.attempts, 4))
+        durability = self._known_durability(entry)
+        durable = durability >= Durability.MAJORITY
+        # a majority-durable txn needs no recovery race, only outcome fetch:
+        # spread the attempts out (and see allow_invalidate below)
+        backoff = self.stall_ms * (2 ** min(entry.attempts + (1 if durable else 0), 4))
         entry.next_attempt_ms = now + backoff + self._jitter()
+        if self.inform_home and not entry.home and entry.attempts == 1 \
+                and entry.home_key is not None \
+                and not entry.last_status.is_decided:
+            # a stalled UNDECIDED txn on a non-home replica: the home shard
+            # owns the recover-or-invalidate decision, so the cheap first
+            # action is telling it the txn exists; this replica escalates to
+            # its own probe only if the txn is still stalled next attempt
+            # (home shard dead/partitioned). Decided txns skip this: each
+            # replica must fetch its own outcome anyway, home can't help.
+            self._inform_home_of_txn(entry)
+            entry.in_flight = False
+            return
         self._retrack_blocking_deps(entry)
 
         def done(value, failure):
             entry.in_flight = False
             self._ensure_scheduled()
 
-        MaybeRecover.probe(self.node, entry.txn_id, entry.participants) \
+        self.node.counters["progress_probes"] += 1
+        # durable => the outcome exists on a quorum: never race to
+        # invalidate it, just fetch (the InformDurable gossip's teeth)
+        MaybeRecover.probe(self.node, entry.txn_id, entry.participants,
+                           allow_invalidate=not durable) \
             .add_callback(done)
+
+    def _inform_home_of_txn(self, entry: _Tracked) -> None:
+        """Send InformOfTxnId to the home shard's replicas (reference:
+        coordinate/InformHomeOfTxn.java:55). Fire-and-forget: failures fall
+        through to this replica's own probe on the next attempt."""
+        from accord_tpu.messages.inform import InformOfTxnId
+        from accord_tpu.primitives.routes import Route
+        node = self.node
+        try:
+            shard = node.topology_manager.current().shard_for_key(entry.home_key)
+        except Exception:
+            return  # topology moved under us; next attempt probes instead
+        route = Route(entry.home_key, entry.participants)
+        for to in shard.nodes:
+            if to != node.id:
+                node.counters["informs_of_txn_sent"] += 1
+                node.send(to, InformOfTxnId(entry.txn_id, route))
 
     def _retrack_blocking_deps(self, entry: _Tracked) -> None:
         """Blocked-dep tracking is normally established by the one-shot
@@ -372,33 +453,44 @@ class StoreProgressLog(ProgressLog):
             return command.txn.keys
         return None
 
+    def _home_key(self, command):
+        return command.route.home_key if command.route is not None else None
+
+    def _track(self, command, is_home: bool) -> None:
+        self.engine.track(command.txn_id, self._participants(command),
+                          command.status, home=is_home,
+                          home_key=self._home_key(command))
+
     def preaccepted(self, command, is_home: bool) -> None:
-        if is_home:
-            self.engine.track(command.txn_id, self._participants(command),
-                              command.status)
+        # home entries drive recovery; non-home UNDECIDED entries are the
+        # orphaned-preaccept safety net (reference NonHomeState): if the
+        # coordinator dies before any home replica witnessed the txn, a
+        # non-home witness informs the home shard after a deferred stall
+        self._track(command, is_home)
 
     def accepted(self, command, is_home: bool) -> None:
-        if is_home:
-            self.engine.track(command.txn_id, self._participants(command),
-                              command.status)
+        self._track(command, is_home)
 
     def committed(self, command, is_home: bool) -> None:
-        self.engine.track(command.txn_id, self._participants(command),
-                          command.status)
+        self._track(command, is_home)
 
     def stable(self, command, is_home: bool) -> None:
         # every replica watches stable-but-unapplied commands: this is what
         # repairs stragglers that missed the Apply broadcast
-        self.engine.track(command.txn_id, self._participants(command),
-                          command.status)
+        self._track(command, is_home)
 
     def readyToExecute(self, command) -> None:
         self.engine.track(command.txn_id, self._participants(command),
-                          command.status)
+                          command.status, home_key=self._home_key(command))
 
     def executed(self, command, is_home: bool) -> None:
+        self._track(command, is_home)
+
+    def informed_of_txn(self, command) -> None:
+        # a peer says this txn exists and we own its home key: drive it
         self.engine.track(command.txn_id, self._participants(command),
-                          command.status)
+                          command.status, home=True,
+                          home_key=self._home_key(command))
 
     def durable(self, command) -> None:
         self.engine.clear(command.txn_id)

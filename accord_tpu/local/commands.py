@@ -387,6 +387,7 @@ def _do_apply(store: CommandStore, cmd: Command) -> None:
         # snapshot; re-applying here would double-write
         cmd.writes.apply_to(store, store.apply_ranges_for(cmd.txn_id))
     cmd.status = Status.APPLIED
+    cmd.durability = cmd.durability.merge(Durability.LOCAL)
     if cmd.txn_id.kind is TxnKind.EXCLUSIVE_SYNC_POINT:
         # every conflicting txn below the ESP has now applied locally
         store.mark_exclusive_sync_point_locally_applied(

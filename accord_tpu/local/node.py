@@ -8,6 +8,7 @@ SURVEY.md section 5).
 """
 from __future__ import annotations
 
+import collections
 import itertools
 from typing import Callable, Dict, Optional, Tuple
 
@@ -42,6 +43,9 @@ class Node:
                  device_latency_ms: float = 4.0,
                  events: Optional[EventsListener] = None):
         self.id = node_id
+        # lightweight observability: protocol event counts (probes sent,
+        # informs exchanged, ...); the burn report and gossip tests read them
+        self.counters: collections.Counter = collections.Counter()
         self.message_sink = message_sink
         self.config_service = config_service
         self.scheduler = scheduler

@@ -14,6 +14,9 @@ from accord_tpu.messages.wait import (
 )
 from accord_tpu.messages.fetch import FetchData, FetchOk
 from accord_tpu.messages.epoch import EpochSyncComplete
+from accord_tpu.messages.inform import (
+    InformDurable, InformHomeDurable, InformOfTxnId,
+)
 
 __all__ = [
     "Request", "Reply", "Callback", "SimpleReply",
@@ -27,4 +30,5 @@ __all__ = [
     "CheckStatus", "CheckStatusOk",
     "AppliedOk", "ApplyThenWaitUntilApplied", "WaitUntilApplied",
     "FetchData", "FetchOk", "EpochSyncComplete",
+    "InformOfTxnId", "InformDurable", "InformHomeDurable",
 ]

@@ -16,7 +16,7 @@ from typing import List, Optional, Tuple
 from accord_tpu.local import commands
 from accord_tpu.local.command import TransientListener
 from accord_tpu.local.commands import AcceptOutcome
-from accord_tpu.local.status import Status, recovery_rank
+from accord_tpu.local.status import Durability, Status, recovery_rank
 from accord_tpu.messages.base import Reply, Request
 from accord_tpu.primitives.deps import Deps
 from accord_tpu.primitives.keyspace import Ranges, Seekables
@@ -415,9 +415,12 @@ class CheckStatus(Request):
                 # _init_waiting_on resurrects dropped deps): the truncation
                 # horizon, not the record, is the truth for below-floor ids
                 if store.is_truncated(self.txn_id, self.participants):
+                    # truncation only happens behind the durability floor:
+                    # the outcome is universally durable by construction
                     return CheckStatusOk(self.txn_id, Status.TRUNCATED,
                                          Ballot.ZERO, None, None, None, None,
-                                         None, None)
+                                         None, None,
+                                         durability=Durability.UNIVERSAL)
             if cmd is None:
                 return CheckStatusOk(self.txn_id, Status.NOT_DEFINED,
                                      Ballot.ZERO, None, None, None, None,
@@ -429,7 +432,8 @@ class CheckStatus(Request):
                                  cmd.execute_at, cmd.route, cmd.txn, deps,
                                  cmd.writes, cmd.result,
                                  execute_at_decided=cmd.has_been(
-                                     Status.PRE_COMMITTED))
+                                     Status.PRE_COMMITTED),
+                                 durability=cmd.durability)
 
         def reduce_fn(a, b):
             return CheckStatusOk.merge(a, b)
@@ -445,12 +449,13 @@ class CheckStatus(Request):
 class CheckStatusOk(Reply):
     __slots__ = ("txn_id", "status", "accepted_ballot", "execute_at", "route",
                  "partial_txn", "stable_deps", "writes", "result",
-                 "execute_at_decided")
+                 "execute_at_decided", "durability")
 
     def __init__(self, txn_id: TxnId, status: Status, accepted_ballot: Ballot,
                  execute_at: Optional[Timestamp], route: Optional[Route],
                  partial_txn: Optional[PartialTxn], stable_deps: Optional[Deps],
-                 writes, result, execute_at_decided: bool = False):
+                 writes, result, execute_at_decided: bool = False,
+                 durability: Durability = Durability.NOT_DURABLE):
         self.txn_id = txn_id
         self.status = status
         self.accepted_ballot = accepted_ballot
@@ -465,6 +470,9 @@ class CheckStatusOk(Reply):
         # timestamp is a proposal, and treating it as an applyable outcome
         # would apply a never-committed txn (the seed-3 split-brain)
         self.execute_at_decided = execute_at_decided
+        # cluster-wide durability knowledge (reference CheckStatusOk carries
+        # Durability too); merge takes the max -- feeds home-shard gossip
+        self.durability = durability
 
     @staticmethod
     def merge(a: "CheckStatusOk", b: "CheckStatusOk") -> "CheckStatusOk":
@@ -501,7 +509,8 @@ class CheckStatusOk(Reply):
             hi.route if hi.route is not None else lo.route,
             txn, deps, writes,
             hi.result if hi.result is not None else lo.result,
-            execute_at_decided=decided)
+            execute_at_decided=decided,
+            durability=hi.durability.merge(lo.durability))
 
     # -- the decision-relevant slice of the reference's Known vector
     # (Status.Known, local/Status.java:126-133); only the two predicates the

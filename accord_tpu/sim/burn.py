@@ -39,10 +39,14 @@ class BurnReport:
         self.events = 0
         self.elapsed_sim_ms = 0.0
         self.log: List[str] = []
+        # cluster-wide protocol event counts (sum of node.counters): probes
+        # sent, informs exchanged -- the home-shard gossip tests compare them
+        self.counters: Dict[str, int] = {}
 
     def as_dict(self) -> dict:
         return {"acked": self.acked, "failed": self.failed, "lost": self.lost,
-                "events": self.events, "elapsed_sim_ms": self.elapsed_sim_ms}
+                "events": self.events, "elapsed_sim_ms": self.elapsed_sim_ms,
+                "counters": dict(self.counters)}
 
 
 def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
@@ -274,6 +278,7 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
             f"completed {state['completed']}/{state['submitted']})")
     cluster.check_no_failures()
     verifier.check_final_state(cluster.converged_key_lists())
+    report.counters = cluster.total_counters()
     return report
 
 

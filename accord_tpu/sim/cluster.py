@@ -29,7 +29,9 @@ class ClusterConfig:
                  timeout_ms: float = 1000.0, deps_resolver_factory=None,
                  deps_batch_window_ms=0.0, device_latency_ms: float = 4.0,
                  progress: bool = True, progress_interval_ms: float = 250.0,
-                 progress_stall_ms: float = 1500.0, serialize: bool = True,
+                 progress_stall_ms: float = 1500.0,
+                 progress_home_defer: float = 3.0,
+                 progress_inform_home: bool = True, serialize: bool = True,
                  durability: bool = False, durability_interval_ms: float = 500.0,
                  preaccept_timeout_ms: float = 1000.0,
                  exec_plane: bool = False, exec_tick_ms: float = 2.0,
@@ -49,6 +51,12 @@ class ClusterConfig:
         self.progress = progress  # enable the liveness/recovery engine
         self.progress_interval_ms = progress_interval_ms
         self.progress_stall_ms = progress_stall_ms
+        # home-shard ownership (reference ProgressShard): non-home undecided
+        # entries defer by this factor and inform the home shard before
+        # probing themselves; defer=1.0 + inform=False restores naive
+        # every-replica-probes behavior (the gossip test compares the two)
+        self.progress_home_defer = progress_home_defer
+        self.progress_inform_home = progress_inform_home
         self.serialize = serialize  # wire-codec round-trip for every message
         # background durability rounds (CoordinateShardDurable rotation);
         # the burn enables them and stops them at workload completion
@@ -199,6 +207,10 @@ class Cluster:
         # per-node liveness cells (kill ghost timers), per-node constructor
         # closures, and a journal of delivered side-effect requests
         self._alive: Dict[NodeId, list] = {}
+        # counters of crashed incarnations (a restart builds a fresh Node,
+        # so whole-run tallies must fold these in; see total_counters)
+        import collections as _collections
+        self.retired_counters = _collections.Counter()
         self._node_rngs: Dict[NodeId, RandomSource] = {}
         self.journals: Dict[NodeId, List] = {}
         self._crash_epoch: Dict[NodeId, int] = {}
@@ -233,7 +245,9 @@ class Cluster:
             from accord_tpu.impl.progress import ProgressEngine
             engine = ProgressEngine(
                 interval_ms=self.config.progress_interval_ms,
-                stall_ms=self.config.progress_stall_ms)
+                stall_ms=self.config.progress_stall_ms,
+                home_defer=self.config.progress_home_defer,
+                inform_home=self.config.progress_inform_home)
             progress_factory = engine.log_for
         time_service = self.time_service
         if self.config.clock_drift:
@@ -303,6 +317,7 @@ class Cluster:
         dead incarnation's coordinations once the node restarts). Returns a
         snapshot of its stable+ command state for the rebuild diff."""
         snapshot = self.stable_snapshot(node_id)
+        self.retired_counters.update(self.nodes[node_id].counters)
         self._crash_epoch[node_id] = self.topology_service.delivered_epoch(node_id)
         self._alive[node_id][0] = False
         self.network.dead.add(node_id)
@@ -485,6 +500,15 @@ class Cluster:
 
     def node(self, node_id: NodeId) -> Node:
         return self.nodes[node_id]
+
+    def total_counters(self) -> Dict[str, int]:
+        """Whole-run protocol event counts: live nodes plus every crashed
+        incarnation's tallies."""
+        totals: Dict[str, int] = dict(self.retired_counters)
+        for node in self.nodes.values():
+            for k, v in node.counters.items():
+                totals[k] = totals.get(k, 0) + v
+        return totals
 
     def current_topology(self) -> Topology:
         return self.topology_service.latest()
