@@ -55,8 +55,8 @@ class TransientListener:
 class Command:
     __slots__ = (
         "txn_id", "status", "durability", "promised", "accepted_ballot",
-        "execute_at", "txn", "route", "deps", "writes", "result",
-        "waiting_on", "waiters", "transient_listeners", "cleaned",
+        "execute_at", "txn", "route", "deps", "accepted_scope", "writes",
+        "result", "waiting_on", "waiters", "transient_listeners", "cleaned",
     )
 
     def __init__(self, txn_id: TxnId):
@@ -69,6 +69,11 @@ class Command:
         self.txn: Optional[PartialTxn] = None
         self.route: Optional[Route] = None
         self.deps: Optional[Deps] = None
+        # the ranges an ACCEPTED proposal's deps actually cover on this store
+        # (reference: PartialDeps.covering): recovery's per-range LatestDeps
+        # merge must not let a narrow higher-ballot accept mask a sibling
+        # range's lower-ballot accepted deps
+        self.accepted_scope = None
         self.writes: Optional[Writes] = None
         self.result = None
         self.waiting_on: Optional[WaitingOn] = None

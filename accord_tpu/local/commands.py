@@ -111,6 +111,7 @@ def accept(store: CommandStore, txn_id: TxnId, ballot: Ballot, route: Route,
     cmd.accepted_ballot = ballot
     if deps is not None:
         cmd.deps = deps.slice(store.ranges)
+        cmd.accepted_scope = keys.to_ranges()
     cmd.status = Status.ACCEPTED
     store.register(txn_id, keys, CfkStatus.WITNESSED, execute_at)
     store.progress_log.accepted(cmd, _is_home(store, cmd))

@@ -80,12 +80,23 @@ class BeginRecovery(Request):
             entries: List[DepsEntry] = []
             if cmd.deps is not None and cmd.has_been(Status.STABLE) \
                     and not cmd.status.is_terminal:
+                # committed deps cover the store's slice of the route scope
+                covering = store.ranges
+                if cmd.route is not None:
+                    covering = covering.intersection(cmd.route.covering())
                 entries.append(DepsEntry(DepsTier.COMMITTED, cmd.accepted_ballot,
-                                         cmd.deps, store.ranges))
+                                         cmd.deps, covering))
             else:
                 if cmd.is_(Status.ACCEPTED) and cmd.deps is not None:
+                    # scope the proposal to the ranges its Accept actually
+                    # covered (reference PartialDeps.covering): claiming the
+                    # whole store slice would let a narrow higher-ballot
+                    # accept mask a sibling range's accepted deps held only
+                    # by other replicas
+                    covering = cmd.accepted_scope \
+                        if cmd.accepted_scope is not None else store.ranges
                     entries.append(DepsEntry(DepsTier.PROPOSAL, cmd.accepted_ballot,
-                                             cmd.deps, store.ranges))
+                                             cmd.deps, covering))
                 local = store.calculate_deps(self.txn_id,
                                              store.owned(self.txn.keys),
                                              self.txn_id.as_timestamp())
