@@ -427,7 +427,11 @@ def propose_invalidate(node, txn_id: TxnId, ballot: Ballot, key,
         accept_round()
         return result
 
-    prepare_tracker = make_tracker()
+    from accord_tpu.coordinate.tracking import InvalidationTracker
+    prepare_tracker = InvalidationTracker(
+        node.topology_manager.with_unsynced_epochs(
+            Route(key, Keys([key])), txn_id.epoch, txn_id.epoch),
+        Keys([key]), txn_id.epoch)
 
     class PrepareCb(Callback):
         # Invalidation is a NEGATIVE decision: like MaybeRecover, wait for
@@ -440,7 +444,6 @@ def propose_invalidate(node, txn_id: TxnId, ballot: Ballot, key,
         def __init__(self):
             self.answered = 0
             self.quorum = False
-            self.promised_clean: set = set()   # replied, no prior fast vote
             self.witnesses: list = []          # (node, status, route)
 
         def on_success(self, from_node, reply) -> None:
@@ -469,9 +472,8 @@ def propose_invalidate(node, txn_id: TxnId, ballot: Ballot, key,
                 # electorate analysis once everyone reachable has answered
                 self.witnesses.append(
                     (from_node, reply.status, reply.route))
-            if not reply.fast_path_vote:
-                self.promised_clean.add(from_node)
-            if prepare_tracker.on_success(from_node) == RequestStatus.SUCCESS:
+            if prepare_tracker.on_success(from_node, reply.fast_path_vote) \
+                    == RequestStatus.SUCCESS:
                 self.quorum = True
             self._maybe_dispatch()
 
@@ -487,26 +489,22 @@ def propose_invalidate(node, txn_id: TxnId, ballot: Ballot, key,
         def _maybe_dispatch(self) -> None:
             if self.answered < len(shard.nodes) or not self.quorum:
                 return
-            if self.witnesses:
-                # Witnessed-but-undecided replies do NOT force recovery when
-                # the fast path is decisively dead (reference:
-                # Invalidate.java:161 isSafeToInvalidate): our promises block
-                # any FUTURE ballot-0 vote (preaccept is ballot-gated), so
-                # the only possible fast voters are those who already voted
-                # plus electorate members we could not reach. The slow path
-                # is blocked by quorum intersection with our promises. If a
-                # fast quorum is still arithmetically possible, fall back to
-                # recovery.
-                potential = [n for n in shard.fast_path_electorate
-                             if n not in self.promised_clean]
-                if len(potential) >= shard.fast_path_quorum_size:
-                    _, status, route = max(self.witnesses, key=lambda w: w[1])
-                    if route is None:
-                        route = next((r for _, _, r in self.witnesses
-                                      if r is not None), None)
-                    result.try_set_failure(
-                        WitnessedElsewhere(txn_id, status, route))
-                    return
+            if self.witnesses \
+                    and not prepare_tracker.is_fast_path_rejected():
+                # Witnessed-but-undecided replies force recovery unless the
+                # fast path is decisively dead (reference: Invalidate.java:161
+                # isSafeToInvalidate via InvalidationTracker): our promises
+                # block any FUTURE ballot-0 vote (preaccept is ballot-gated),
+                # so the only possible fast voters are those who already
+                # voted plus electorate members we could not reach; the slow
+                # path is blocked by quorum intersection with our promises.
+                _, status, route = max(self.witnesses, key=lambda w: w[1])
+                if route is None:
+                    route = next((r for _, _, r in self.witnesses
+                                  if r is not None), None)
+                result.try_set_failure(
+                    WitnessedElsewhere(txn_id, status, route))
+                return
             accept_round()
 
     prep = PrepareCb()
@@ -566,11 +564,15 @@ class MaybeRecover(Callback):
     because a bare quorum can simply have missed the one witness."""
 
     def __init__(self, node, txn_id: TxnId, participants: Seekables,
-                 allow_invalidate: bool):
+                 allow_invalidate: bool, token_sink=None):
         self.node = node
         self.txn_id = txn_id
         self.participants = participants
         self.allow_invalidate = allow_invalidate
+        # observer of the merged ProgressToken (reference: MaybeRecover
+        # completes with a ProgressToken; the progress engine compares
+        # successive tokens to detect remote movement)
+        self.token_sink = token_sink
         self.result: AsyncResult = AsyncResult()
         self.topologies = node.topology_manager.with_unsynced_epochs(
             Route(None, participants), txn_id.epoch, txn_id.epoch)
@@ -582,8 +584,8 @@ class MaybeRecover(Callback):
 
     @classmethod
     def probe(cls, node, txn_id: TxnId, participants: Seekables,
-              allow_invalidate: bool = True) -> AsyncResult:
-        self = cls(node, txn_id, participants, allow_invalidate)
+              allow_invalidate: bool = True, token_sink=None) -> AsyncResult:
+        self = cls(node, txn_id, participants, allow_invalidate, token_sink)
         targets = self.tracker.nodes()
         self.contacted = len(targets)
         for to in targets:
@@ -621,6 +623,8 @@ class MaybeRecover(Callback):
                 self.result.try_set_failure(Timeout(f"checkStatus {self.txn_id}"))
             return
         merged = self._merged()
+        if self.token_sink is not None:
+            self.token_sink(merged.to_progress_token())
         have_quorum = self.tracker.decided == RequestStatus.SUCCESS
         all_in = self.answered >= self.contacted
 

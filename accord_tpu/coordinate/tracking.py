@@ -232,5 +232,46 @@ class RecoveryTracker(AbstractTracker):
             for st in self.shards)
 
 
+class InvalidationTracker(FastPathTracker):
+    """Accounting for a BeginInvalidation prepare round (reference:
+    InvalidationTracker.java:28): tracks the promise quorum across every
+    spanned epoch (vote accounting shared with FastPathTracker), plus the
+    fast-path rejection arithmetic -- scoped to the txn's ORIGINAL epoch,
+    where any ballot-0 fast quorum must have formed -- that decides whether
+    the original fast path is decisively dead (safe to invalidate) or still
+    arithmetically possible (must recover instead)."""
+
+    def __init__(self, topologies: Topologies, seekables: Seekables,
+                 fast_path_epoch: int):
+        super().__init__(topologies, seekables)
+        self._fast_states: List[_ShardState] = []
+        i = 0
+        for topology in topologies:
+            shards = topology.shards_for(seekables)
+            for _ in shards:
+                if topology.epoch == fast_path_epoch:
+                    self._fast_states.append(self.shards[i])
+                i += 1
+
+    def _is_success(self) -> bool:
+        # unlike the parent, invalidation needs only the promise quorum;
+        # fast-path resolution is consulted separately via
+        # is_fast_path_rejected once every reachable reply is in
+        return all(s.has_quorum() for s in self.shards)
+
+    def is_fast_path_rejected(self) -> bool:
+        """More REPLIED electorate members cast no ballot-0 fast vote than
+        the electorate can spare: no fast quorum ever formed, and our
+        promises gate any future vote (reference: isFastPathRejected).
+        Failed members prove nothing and are excluded."""
+        if not self._fast_states:
+            return False
+        return all(
+            st.shard.rejects_fast_path(
+                len((st.fast_rejects & st.shard.fast_path_electorate)
+                    - st.failures))
+            for st in self._fast_states)
+
+
 class AppliedTracker(QuorumTracker):
     """Quorum of Apply acks per shard (durability tracking)."""

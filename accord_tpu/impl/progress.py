@@ -30,7 +30,8 @@ from accord_tpu.primitives.timestamp import TxnId
 
 class _Tracked:
     __slots__ = ("txn_id", "participants", "last_status", "last_change_ms",
-                 "attempts", "next_attempt_ms", "in_flight", "home", "home_key")
+                 "attempts", "next_attempt_ms", "in_flight", "home", "home_key",
+                 "last_token")
 
     def __init__(self, txn_id: TxnId, participants, status: Status, now_ms: float,
                  home: bool = True, home_key=None):
@@ -41,6 +42,11 @@ class _Tracked:
         self.attempts = 0
         self.next_attempt_ms = 0.0
         self.in_flight = False
+        # merged ProgressToken from the last probe: remote movement between
+        # probes (a new ballot, durability, a phase advance ANYWHERE) resets
+        # the escalation backoff even when local state is unchanged
+        # (reference: SimpleProgressLog compares successive ProgressTokens)
+        self.last_token = None
         # home-shard ownership (reference ProgressShard.Home vs NonHome):
         # home entries drive recovery at full cadence; non-home entries defer
         # and first INFORM the home shard instead of probing themselves
@@ -391,11 +397,20 @@ class ProgressEngine:
             entry.in_flight = False
             self._ensure_scheduled()
 
+        def on_token(token, entry=entry):
+            prev = entry.last_token
+            entry.last_token = token if prev is None else prev.merge(token)
+            if prev is not None and prev < entry.last_token:
+                # something moved cluster-wide since the last probe: whoever
+                # is driving it is alive, so stop escalating our backoff
+                entry.attempts = 1
+
         self.node.counters["progress_probes"] += 1
         # durable => the outcome exists on a quorum: never race to
         # invalidate it, just fetch (the InformDurable gossip's teeth)
         MaybeRecover.probe(self.node, entry.txn_id, entry.participants,
-                           allow_invalidate=not durable) \
+                           allow_invalidate=not durable,
+                           token_sink=on_token) \
             .add_callback(done)
 
     def _inform_home_of_txn(self, entry: _Tracked) -> None:

@@ -120,6 +120,38 @@ class Bootstrap:
         if self.aborted:
             return
         self.node.data_store.merge_entries(merged)
+        # seed the acquired ranges' conflict registry: the snapshot carries
+        # data, not conflict history, so without this a fresh replica's
+        # preaccept could witness a new txn BELOW already-committed
+        # conflicts (reference: FetchMaxConflict establishing safe-to-read,
+        # local/Bootstrap.java:239)
+        self._fetch_max_conflict()
+
+    def _fetch_max_conflict(self) -> None:
+        # a transient failure retries ONLY this cheap timestamp read -- the
+        # sync point and snapshot (steps 1-3) are already done and must not
+        # be re-coordinated/re-transferred for it
+        from accord_tpu.coordinate.maxconflict import FetchMaxConflict
+        if self.aborted:
+            return
+
+        def retry(failure):
+            if self.aborted:
+                return
+            self.node.agent.on_failed_bootstrap(
+                "max_conflict", self.ranges, lambda: None, failure)
+            self.node.scheduler.once(self.RETRY_BACKOFF_MS,
+                                     self._fetch_max_conflict)
+
+        FetchMaxConflict.fetch(self.node, self.ranges) \
+            .on_success(self._seed_and_complete) \
+            .on_failure(retry)
+
+    def _seed_and_complete(self, max_conflict) -> None:
+        if self.aborted:
+            return
+        if max_conflict is not None:
+            self.store.update_max_conflicts(self.ranges, max_conflict)
         if self in self.store.active_bootstraps:
             self.store.active_bootstraps.remove(self)
         self.store.fill_gap(self.ranges)

@@ -111,3 +111,46 @@ class Durability(enum.IntEnum):
 
     def merge(self, other: "Durability") -> "Durability":
         return max(self, other)
+
+
+class ProgressToken:
+    """Compact summary of a command's observed activity (reference:
+    primitives/ProgressToken.java): (durability, phase, promised ballot).
+    Totally ordered so a liveness driver can tell whether ANYTHING moved
+    cluster-wide between two probes of a stalled txn -- even when the local
+    record did not -- and reset its escalation backoff accordingly."""
+
+    __slots__ = ("durability", "status", "promised")
+
+    def __init__(self, durability: Durability, status: Status, promised: Ballot):
+        self.durability = durability
+        self.status = status
+        self.promised = promised
+
+    def _key(self):
+        return (self.durability, self.status.phase, self.promised, self.status)
+
+    def merge(self, other: "ProgressToken") -> "ProgressToken":
+        return ProgressToken(self.durability.merge(other.durability),
+                             max(self.status, other.status),
+                             max(self.promised, other.promised))
+
+    def __eq__(self, other):
+        return isinstance(other, ProgressToken) and self._key() == other._key()
+
+    def __lt__(self, other):
+        return self._key() < other._key()
+
+    def __le__(self, other):
+        return self._key() <= other._key()
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __repr__(self):
+        return (f"ProgressToken({self.durability.name}, {self.status.name}, "
+                f"{self.promised!r})")
+
+
+ProgressToken.NONE = ProgressToken(Durability.NOT_DURABLE, Status.NOT_DEFINED,
+                                   Ballot.ZERO)

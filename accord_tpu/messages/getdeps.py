@@ -1,8 +1,11 @@
 """GetDeps: standalone dependency collection (reference:
 messages/GetDeps.java) -- ask a replica which witnessed conflicts started
 before a given bound. Used by recovery's CollectDeps when no committed deps
-cover a shard, and later by sync points."""
+cover a shard, and later by sync points. GetMaxConflict (reference:
+messages/GetMaxConflict.java) is its timestamp-only sibling."""
 from __future__ import annotations
+
+from typing import Optional
 
 from accord_tpu.messages.base import Reply, Request
 from accord_tpu.primitives.deps import Deps
@@ -90,3 +93,48 @@ class GetEphemeralReadDepsOk(Reply):
 
     def __repr__(self):
         return f"GetEphemeralReadDepsOk({self.txn_id!r}, epoch={self.latest_epoch})"
+
+
+class GetMaxConflict(Request):
+    """Max witnessed conflict timestamp over some keys/ranges (reference:
+    messages/GetMaxConflict.java): the timestamp-only sibling of GetDeps.
+    Used by bootstrap to seed a freshly-acquired range's conflict registry
+    (the snapshot carries data, not conflict history)."""
+
+    def __init__(self, keys: Seekables, min_epoch: int = 0):
+        self.keys = keys
+        self.wait_for_epoch = min_epoch
+
+    @property
+    def has_side_effects(self) -> bool:
+        return False
+
+    def process(self, node, from_node, reply_context) -> None:
+        def map_fn(store):
+            ts = store.max_conflict_ts(store.owned(self.keys))
+            return MaxConflictOk(ts, node.epoch)
+
+        def reduce_fn(a, b):
+            return MaxConflictOk(Timestamp.merge_max(a.max_conflict,
+                                                     b.max_conflict),
+                                 max(a.latest_epoch, b.latest_epoch))
+
+        node.command_stores.map_reduce(self.keys, map_fn, reduce_fn) \
+            .on_success(lambda reply: node.reply(
+                from_node, reply_context,
+                reply if reply is not None else MaxConflictOk(None, node.epoch))) \
+            .on_failure(node.agent.on_uncaught_exception)
+
+    def __repr__(self):
+        return f"GetMaxConflict({self.keys!r})"
+
+
+class MaxConflictOk(Reply):
+    __slots__ = ("max_conflict", "latest_epoch")
+
+    def __init__(self, max_conflict: Optional[Timestamp], latest_epoch: int):
+        self.max_conflict = max_conflict
+        self.latest_epoch = latest_epoch
+
+    def __repr__(self):
+        return f"MaxConflictOk({self.max_conflict!r})"

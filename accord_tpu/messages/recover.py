@@ -444,7 +444,8 @@ class CheckStatus(Request):
                                  cmd.writes, cmd.result,
                                  execute_at_decided=cmd.has_been(
                                      Status.PRE_COMMITTED),
-                                 durability=cmd.durability)
+                                 durability=cmd.durability,
+                                 promised=cmd.promised)
 
         def reduce_fn(a, b):
             return CheckStatusOk.merge(a, b)
@@ -460,13 +461,14 @@ class CheckStatus(Request):
 class CheckStatusOk(Reply):
     __slots__ = ("txn_id", "status", "accepted_ballot", "execute_at", "route",
                  "partial_txn", "stable_deps", "writes", "result",
-                 "execute_at_decided", "durability")
+                 "execute_at_decided", "durability", "promised")
 
     def __init__(self, txn_id: TxnId, status: Status, accepted_ballot: Ballot,
                  execute_at: Optional[Timestamp], route: Optional[Route],
                  partial_txn: Optional[PartialTxn], stable_deps: Optional[Deps],
                  writes, result, execute_at_decided: bool = False,
-                 durability: Durability = Durability.NOT_DURABLE):
+                 durability: Durability = Durability.NOT_DURABLE,
+                 promised: Ballot = Ballot.ZERO):
         self.txn_id = txn_id
         self.status = status
         self.accepted_ballot = accepted_ballot
@@ -484,6 +486,10 @@ class CheckStatusOk(Reply):
         # cluster-wide durability knowledge (reference CheckStatusOk carries
         # Durability too); merge takes the max -- feeds home-shard gossip
         self.durability = durability
+        # highest promised ballot: prepare-phase movement is ACTIVITY even
+        # when nothing is accepted yet -- the ProgressToken reads this so a
+        # competing recoverer's rounds reset observers' escalation backoff
+        self.promised = promised
 
     @staticmethod
     def merge(a: "CheckStatusOk", b: "CheckStatusOk") -> "CheckStatusOk":
@@ -521,7 +527,14 @@ class CheckStatusOk(Reply):
             txn, deps, writes,
             hi.result if hi.result is not None else lo.result,
             execute_at_decided=decided,
-            durability=hi.durability.merge(lo.durability))
+            durability=hi.durability.merge(lo.durability),
+            promised=max(hi.promised, lo.promised))
+
+    def to_progress_token(self):
+        """Compact activity summary (reference: ProgressToken): enough for a
+        liveness driver to detect cluster-wide movement between probes."""
+        from accord_tpu.local.status import ProgressToken
+        return ProgressToken(self.durability, self.status, self.promised)
 
     # -- the decision-relevant slice of the reference's Known vector
     # (Status.Known, local/Status.java:126-133); only the two predicates the

@@ -1,0 +1,73 @@
+"""GetMaxConflict / FetchMaxConflict: the timestamp-only deps-query sibling
+(reference: messages/GetMaxConflict.java, coordinate/FetchMaxConflict.java:44)
+and its production role -- seeding a bootstrapped range's conflict registry
+(reference: local/Bootstrap.java:239)."""
+from __future__ import annotations
+
+from accord_tpu.coordinate.maxconflict import FetchMaxConflict
+from accord_tpu.primitives.keyspace import Keys, Range, Ranges
+from accord_tpu.primitives.timestamp import TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.sim.cluster import Cluster, ClusterConfig
+from accord_tpu.sim.list_store import ListQuery, ListRead, ListUpdate
+from accord_tpu.topology.shard import Shard
+from accord_tpu.topology.topology import Topology
+
+
+def write_txn(keys: Keys, value: int) -> Txn:
+    return Txn(TxnKind.WRITE, keys, read=ListRead(keys),
+               update=ListUpdate(keys, value), query=ListQuery())
+
+
+def test_fetch_max_conflict_sees_committed_writes():
+    cl = Cluster(31, ClusterConfig(num_nodes=3, rf=3))
+    n1 = cl.node(1)
+    keys = Keys([100, 40000])
+    results = []
+    for v in (1, 2, 3):
+        results.append(n1.coordinate(write_txn(keys, v)))
+    cl.drain()
+    assert all(r.done and r.failure is None for r in results)
+    max_exec = max(r.value().txn_id.as_timestamp() for r in results)
+
+    got = FetchMaxConflict.fetch(cl.node(2), Ranges([Range(0, 65536)]))
+    cl.drain()
+    assert got.done and got.failure is None
+    assert got.value() is not None and got.value() >= max_exec
+
+    # untouched ranges know no conflicts
+    empty = FetchMaxConflict.fetch(cl.node(2), Ranges([Range(20000, 30000)]))
+    cl.drain()
+    assert empty.done and empty.value() is None
+
+
+def test_bootstrap_seeds_max_conflicts():
+    """A replica gaining a range must learn its conflict high-water mark, not
+    just its data: a fresh store that witnessed nothing would otherwise cast
+    preaccept votes below already-committed conflicts."""
+    cl = Cluster(32, ClusterConfig(num_nodes=4, rf=3))
+    n1 = cl.node(1)
+    keys = Keys([10, 500])  # shard 0 = [0, 16384) on nodes (1, 2, 3)
+    for v in (1, 2):
+        n1.coordinate(write_txn(keys, v))
+    cl.drain()
+    cl.check_no_failures()
+    old_max = max(
+        s.max_conflict_ts(keys)
+        for s in cl.node(1).command_stores.all()
+        if s.max_conflict_ts(keys) is not None)
+
+    t1 = cl.current_topology()
+    shards = list(t1.shards)
+    shards[0] = Shard(shards[0].range, [2, 3, 4])  # hand shard 0 to node 4
+    cl.issue_topology(Topology(2, shards))
+    cl.drain()
+    cl.check_no_failures()
+
+    seeded = None
+    for s in cl.node(4).command_stores.all():
+        ts = s.max_conflict_ts(s.owned(keys))
+        if ts is not None:
+            seeded = ts if seeded is None else max(seeded, ts)
+    assert seeded is not None and seeded >= old_max, \
+        f"bootstrapped replica's conflict registry not seeded: {seeded}"

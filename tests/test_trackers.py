@@ -115,3 +115,70 @@ def test_read_tracker_exhaustion():
     _, (c3,) = t.on_read_failure(c2)
     status, more = t.on_read_failure(c3)
     assert status == RequestStatus.FAILED
+
+
+def test_invalidation_tracker():
+    """(reference: InvalidationTracker.java:28) promise quorum + the
+    fast-path-rejection arithmetic scoped to the txn's original epoch."""
+    from accord_tpu.coordinate.tracking import InvalidationTracker
+    from accord_tpu.primitives.keyspace import Keys
+
+    def make():
+        return InvalidationTracker(
+            Topologies.single(Topology(1, [Shard(Range(0, 100), [1, 2, 3, 4, 5])])),
+            Keys([10]), fast_path_epoch=1)
+
+    # rf=5: fast quorum 4, electorate 5, spare = 1 -> rejected needs >1 rejects
+    t = make()
+    assert t.on_success(1, False) == RequestStatus.NO_CHANGE
+    assert not t.is_fast_path_rejected()       # 1 reject <= spare
+    assert t.on_success(2, False) == RequestStatus.NO_CHANGE
+    assert t.is_fast_path_rejected()           # 2 rejects > spare: fp dead
+    assert t.on_success(3, True) == RequestStatus.SUCCESS
+    assert t.is_fast_path_rejected()
+
+    # a fast VOTE never contributes to rejection
+    t = make()
+    t.on_success(1, True)
+    t.on_success(2, True)
+    t.on_success(3, False)
+    assert not t.is_fast_path_rejected()
+
+    # failures prove nothing about the original fast path
+    t = make()
+    t.on_success(1, False)
+    t.on_failure(2)
+    t.on_failure(3)
+    assert not t.is_fast_path_rejected()
+
+    # no shard state at the fast-path epoch (retired): never "safe"
+    t = InvalidationTracker(
+        Topologies.single(Topology(2, [Shard(Range(0, 100), [1, 2, 3])])),
+        Keys([10]), fast_path_epoch=1)
+    t.on_success(1, False)
+    t.on_success(2, False)
+    t.on_success(3, False)
+    assert not t.is_fast_path_rejected()
+
+
+def test_progress_token_order_and_merge():
+    """(reference: primitives/ProgressToken.java) durability dominates, then
+    phase, then ballot; merge is the component-wise max."""
+    from accord_tpu.local.status import Durability, ProgressToken, Status
+    from accord_tpu.primitives.timestamp import Ballot, Timestamp
+
+    b1 = Ballot.from_timestamp(Timestamp(1, 5, 0, 1))
+    b2 = Ballot.from_timestamp(Timestamp(1, 9, 0, 2))
+    none = ProgressToken.NONE
+    preaccepted = ProgressToken(Durability.NOT_DURABLE, Status.PRE_ACCEPTED,
+                                Ballot.ZERO)
+    accepted_b1 = ProgressToken(Durability.NOT_DURABLE, Status.ACCEPTED, b1)
+    accepted_b2 = ProgressToken(Durability.NOT_DURABLE, Status.ACCEPTED, b2)
+    applied = ProgressToken(Durability.NOT_DURABLE, Status.APPLIED, Ballot.ZERO)
+    durable = ProgressToken(Durability.MAJORITY, Status.PRE_ACCEPTED, Ballot.ZERO)
+
+    assert none < preaccepted < accepted_b1 < accepted_b2 < applied < durable
+    m = accepted_b1.merge(durable)
+    assert m.durability == Durability.MAJORITY
+    assert m.status == Status.ACCEPTED and m.promised == b1
+    assert accepted_b1.merge(accepted_b1) == accepted_b1
