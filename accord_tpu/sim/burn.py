@@ -25,7 +25,8 @@ from accord_tpu.primitives.txn import Txn
 from accord_tpu.sim.cluster import Cluster, ClusterConfig
 from accord_tpu.sim.network import LinkConfig
 from accord_tpu.sim.list_store import (
-    ListQuery, ListRangeRead, ListRead, ListResult, ListUpdate,
+    ListQuery, ListRangeRead, ListRangeUpdate, ListRead, ListResult,
+    ListUpdate,
 )
 from accord_tpu.sim.verifier import StrictSerializabilityVerifier
 from accord_tpu.utils.rng import RandomSource
@@ -57,7 +58,8 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
              chaos_drop: float = 0.0, chaos_partitions: bool = False,
              topology_churn: bool = False, churn_interval_ms: float = 1000.0,
              crash_restart: bool = False, crash_down_ms: float = 800.0,
-             range_read_ratio: float = 0.0, max_range_width: int = 2048,
+             range_read_ratio: float = 0.0, range_write_ratio: float = 0.0,
+             max_range_width: int = 2048,
              config: Optional[ClusterConfig] = None,
              collect_log: bool = False) -> BurnReport:
     cfg = config or ClusterConfig(num_nodes=nodes, rf=rf)
@@ -78,18 +80,36 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
         def pick_key():
             return wl_rng.pick(key_space)
 
+    def gen_range() -> Ranges:
+        anchor = pick_key()
+        width = 1 + wl_rng.next_int(max_range_width)
+        start = max(0, anchor - wl_rng.next_int(width))
+        end = min(cfg.key_domain, start + width)
+        return Ranges([Range(start, max(end, start + 1))])
+
     def gen_txn() -> Tuple[Txn, Optional[int], Dict]:
         if range_read_ratio > 0.0 and wl_rng.decide(range_read_ratio):
             # range-domain READ over an interval of the hash domain
             # (reference burn generates range reads, BurnTest.java:123)
-            anchor = pick_key()
-            width = 1 + wl_rng.next_int(max_range_width)
-            start = max(0, anchor - wl_rng.next_int(width))
-            end = min(cfg.key_domain, start + width)
-            ranges = Ranges([Range(start, max(end, start + 1))])
+            ranges = gen_range()
             txn = Txn(TxnKind.READ, ranges, read=ListRangeRead(ranges),
                       query=ListQuery())
             return txn, None, {}
+        if range_write_ratio > 0.0 and wl_rng.decide(range_write_ratio):
+            # range-domain WRITE: conflicts/deps ride the RANGE domain
+            # (RangeDeps write paths), while the value lands on the hot keys
+            # inside the range so the strict-serializability verifier knows
+            # the write set up front
+            ranges = gen_range()
+            rng0 = ranges[0]
+            targets = Keys(k for k in key_space
+                           if rng0.start <= k < rng0.end)
+            value = state["next_value"]
+            state["next_value"] += 1
+            txn = Txn(TxnKind.WRITE, ranges, read=ListRangeRead(ranges),
+                      update=ListRangeUpdate(ranges, targets, value),
+                      query=ListQuery())
+            return txn, value, {k: value for k in targets}
         if ephemeral_read_ratio > 0.0 and wl_rng.decide(ephemeral_read_ratio):
             # SINGLE-key ephemeral read: strict-serializable (multi-key
             # ephemeral reads are only per-key linearizable -- reference
@@ -293,6 +313,8 @@ def main(argv=None) -> int:
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--chaos-drop", type=float, default=0.0,
                     help="max per-link drop probability (re-randomized every 2s)")
+    ap.add_argument("--range-read-ratio", type=float, default=0.0)
+    ap.add_argument("--range-write-ratio", type=float, default=0.0)
     ap.add_argument("--chaos-partitions", action="store_true",
                     help="periodically partition a random node")
     ap.add_argument("--topology-churn", action="store_true",
@@ -309,6 +331,8 @@ def main(argv=None) -> int:
         kwargs = dict(ops=args.ops, nodes=args.nodes, rf=args.rf,
                       key_count=args.keys, concurrency=args.concurrency,
                       chaos_drop=args.chaos_drop,
+                      range_read_ratio=args.range_read_ratio,
+                      range_write_ratio=args.range_write_ratio,
                       chaos_partitions=args.chaos_partitions,
                       topology_churn=args.topology_churn,
                       churn_interval_ms=args.churn_interval_ms,

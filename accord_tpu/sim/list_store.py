@@ -145,6 +145,54 @@ class ListUpdate(api.Update):
         return ListUpdate(self._keys.union(other._keys), self.value)
 
 
+class ListRangeWrite(api.Write):
+    """Range-domain write: append `appends[k]` to every target key that
+    falls inside the applied ranges. Targets are FIXED at generation time
+    (the workload's hot key set sliced by the range) so the verifier knows
+    the write set up front, while conflicts ride the RANGE domain."""
+
+    def __init__(self, appends: Dict[object, int]):
+        self.appends = appends
+
+    def apply(self, key, store, execute_at: Timestamp) -> None:
+        if key in self.appends:
+            store.node.data_store.append(key, execute_at, self.appends[key])
+
+    def apply_ranges(self, ranges: Ranges, store, execute_at: Timestamp) -> None:
+        data_store: ListStore = store.node.data_store
+        for k, v in self.appends.items():
+            if ranges.contains_key(k):
+                data_store.append(k, execute_at, v)
+
+
+class ListRangeUpdate(api.Update):
+    """Append `value` to each of `targets` (keys), with the conflict scope
+    being `ranges` (range-domain deps/ordering)."""
+
+    def __init__(self, ranges: Ranges, targets: Keys, value: int):
+        self._ranges = ranges
+        self._targets = targets
+        self.value = value
+
+    def keys(self) -> Ranges:
+        return self._ranges
+
+    def apply(self, execute_at: Timestamp, data) -> ListRangeWrite:
+        return ListRangeWrite({k: self.value for k in self._targets})
+
+    def slice(self, ranges: Ranges) -> "ListRangeUpdate":
+        return ListRangeUpdate(self._ranges.intersection(ranges),
+                               self._targets.slice(ranges), self.value)
+
+    def merge(self, other: "ListRangeUpdate") -> "ListRangeUpdate":
+        assert self.value == other.value
+        return ListRangeUpdate(self._ranges.union(other._ranges),
+                               self._targets.union(other._targets), self.value)
+
+    def target_keys(self) -> Keys:
+        return self._targets
+
+
 class ListResult(api.Result):
     def __init__(self, txn_id: TxnId, execute_at: Timestamp,
                  reads: Dict[object, Tuple[int, ...]], write_value: Optional[int]):
@@ -166,6 +214,13 @@ class ListQuery(api.Query):
         # Range itself is not a reads-dict key)
         if read is not None and isinstance(read.keys(), Keys):
             for k in read.keys():
+                reads.setdefault(k, ())
+        # a range WRITE's scan also observed each absent target key as empty:
+        # report them so none of its per-key appends is a blind write (the
+        # verifier tracks blind writes one key per value)
+        target_keys = getattr(update, "target_keys", None)
+        if target_keys is not None:
+            for k in target_keys():
                 reads.setdefault(k, ())
         return ListResult(txn_id, execute_at, reads,
                           update.value if update is not None else None)

@@ -1,0 +1,116 @@
+"""CommandsForKey unit edge cases: update monotonicity, prune boundaries,
+conflict-scan filters (reference: the cfk update/prune unit coverage around
+local/cfk/CommandsForKey.java:910, Pruning.java:41)."""
+from __future__ import annotations
+
+from accord_tpu.local.cfk import CfkStatus, CommandsForKey
+from accord_tpu.primitives.timestamp import Timestamp, TxnId, TxnKind
+
+
+def tid(hlc, node=1, kind=TxnKind.WRITE):
+    return TxnId.create(1, hlc, node, kind)
+
+
+def ts(hlc):
+    return Timestamp(1, hlc, 0, 9)
+
+
+def test_update_is_status_monotone():
+    cfk = CommandsForKey(7)
+    t = tid(5)
+    cfk.update(t, CfkStatus.COMMITTED, ts(8))
+    cfk.update(t, CfkStatus.WITNESSED, None)  # stale report must not regress
+    assert cfk.get(t).status == CfkStatus.COMMITTED
+    assert cfk.get(t).execute_at == ts(8)
+    cfk.update(t, CfkStatus.APPLIED, ts(8))
+    assert cfk.get(t).status == CfkStatus.APPLIED
+
+
+def test_max_applied_write_tracks_only_writes():
+    cfk = CommandsForKey(7)
+    cfk.update(tid(5, kind=TxnKind.READ), CfkStatus.APPLIED, ts(6))
+    assert cfk.max_applied_write is None
+    cfk.update(tid(7), CfkStatus.APPLIED, ts(9))
+    assert cfk.max_applied_write == ts(9)
+    cfk.update(tid(8), CfkStatus.APPLIED, ts(8))  # lower executeAt: no regress
+    assert cfk.max_applied_write == ts(9)
+
+
+def test_prune_keeps_unapplied_and_straddlers():
+    """Only APPLIED/INVALIDATED entries WHOLLY below the floor are pruned: a
+    txn id below the floor whose executeAt landed above it must survive (its
+    ordering is not subsumed by the floor dep)."""
+    cfk = CommandsForKey(7)
+    done_low = tid(2)
+    cfk.update(done_low, CfkStatus.APPLIED, ts(3))
+    straddler = tid(4)
+    cfk.update(straddler, CfkStatus.APPLIED, ts(50))     # executeAt above floor
+    unapplied = tid(5)
+    cfk.update(unapplied, CfkStatus.COMMITTED, ts(6))    # not yet applied
+    invalidated = tid(6)
+    cfk.update(invalidated, CfkStatus.INVALIDATED, None)
+    above = tid(40)
+    cfk.update(above, CfkStatus.APPLIED, ts(41))
+
+    pruned = cfk.prune_below(ts(10))
+    assert set(pruned) == {done_low, invalidated}
+    assert cfk.get(done_low) is None
+    assert cfk.get(straddler) is not None, "straddler pruned"
+    assert cfk.get(unapplied) is not None, "unapplied entry pruned"
+    assert cfk.get(above) is not None
+
+    # pruning is idempotent
+    assert cfk.prune_below(ts(10)) == []
+
+
+def test_conflicts_before_filters():
+    """The deps scan excludes the subject itself, invalidated entries, ids at
+    or above the bound, and kinds the subject does not witness."""
+    cfk = CommandsForKey(7)
+    w1, w2 = tid(2), tid(4)
+    r1 = tid(3, kind=TxnKind.READ)
+    dead = tid(5)
+    cfk.update(w1, CfkStatus.COMMITTED, ts(2))
+    cfk.update(w2, CfkStatus.WITNESSED, None)
+    cfk.update(r1, CfkStatus.COMMITTED, ts(3))
+    cfk.update(dead, CfkStatus.INVALIDATED, None)
+
+    subject_w = tid(9)
+    got = tuple(cfk.conflicts_before(subject_w, ts(100)))
+    # a write witnesses both reads and writes; the invalidated id is skipped
+    assert got == (w1, r1, w2)
+
+    subject_r = tid(9, kind=TxnKind.READ)
+    got_r = tuple(cfk.conflicts_before(subject_r, ts(100)))
+    # a read witnesses only writes
+    assert got_r == (w1, w2)
+
+    # the bound is exclusive and cuts by txn id
+    assert tuple(cfk.conflicts_before(subject_w, tid(4).as_timestamp())) \
+        == (w1, r1)
+    # the subject never witnesses itself
+    assert w2 not in tuple(cfk.conflicts_before(w2, ts(100)))
+
+
+def test_rewitness_after_prune_recreates_entry():
+    """A pruned id re-reported (e.g. by a straggler's late Commit replay)
+    re-enters the registry -- prune is a space decision, not a truth one; the
+    caller-side floor injection keeps the dep ordering correct."""
+    cfk = CommandsForKey(7)
+    t = tid(2)
+    cfk.update(t, CfkStatus.APPLIED, ts(3))
+    assert cfk.prune_below(ts(10)) == [t]
+    cfk.update(t, CfkStatus.APPLIED, ts(3))
+    assert cfk.get(t) is not None
+    assert cfk.prune_below(ts(10)) == [t]
+
+
+def test_max_conflict_prefers_execute_at():
+    cfk = CommandsForKey(7)
+    t = tid(5)
+    cfk.update(t, CfkStatus.COMMITTED, ts(30))  # executeAt far above id
+    assert cfk.max_conflict(TxnKind.WRITE) == ts(30)
+    dead = tid(50)
+    cfk.update(dead, CfkStatus.INVALIDATED, None)
+    assert cfk.max_conflict(TxnKind.WRITE) == ts(30), \
+        "invalidated entry contributed to max conflict"

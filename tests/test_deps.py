@@ -67,3 +67,120 @@ def test_deps_merge():
     assert m.max_txn_id() == tid(3)
     assert not m.is_empty()
     assert Deps.NONE.is_empty()
+
+
+def test_rangedeps_randomized_vs_naive():
+    """Randomized union/slice/without/merge/point+overlap queries against a
+    naive interval-list model (reference: DepsTest.java's random-vs-model
+    strategy)."""
+    rng = random.Random(11)
+    for trial in range(30):
+        naive = []  # list of (Range, txn_id)
+        b = RangeDepsBuilder()
+        for _ in range(rng.randrange(0, 50)):
+            s = rng.randrange(0, 90)
+            r = Range(s, s + 1 + rng.randrange(12))
+            t = tid(rng.randrange(25), rng.randrange(3))
+            naive.append((r, t))
+            b.add(r, t)
+        rd = b.build()
+
+        def naive_for_key(k):
+            return tuple(sorted({t for r, t in naive
+                                 if r.start <= k < r.end}))
+
+        def naive_intersecting(q):
+            return tuple(sorted({t for r, t in naive
+                                 if r.start < q.end and q.start < r.end}))
+
+        for k in rng.sample(range(0, 105), 12):
+            assert rd.for_key(k) == naive_for_key(k), f"trial {trial} key {k}"
+        for _ in range(6):
+            s = rng.randrange(0, 100)
+            q = Range(s, s + 1 + rng.randrange(15))
+            assert rd.intersecting(q) == naive_intersecting(q), \
+                f"trial {trial} query {q}"
+        assert rd.all_txn_ids() == tuple(sorted({t for _, t in naive}))
+
+        # slice: only intersections with the window survive
+        s = rng.randrange(0, 80)
+        window = Ranges.of(Range(s, s + 20))
+        sliced = rd.slice(window)
+        for k in range(max(0, s - 3), s + 23):
+            inside = s <= k < s + 20
+            expect = naive_for_key(k) if inside else ()
+            assert sliced.for_key(k) == expect, \
+                f"trial {trial} slice key {k}"
+
+        # without: predicate drops ids everywhere
+        cut = rng.randrange(25)
+        wo = rd.without(lambda t: t.hlc < cut)
+        for k in rng.sample(range(0, 105), 8):
+            assert wo.for_key(k) == tuple(
+                t for t in naive_for_key(k) if not t.hlc < cut)
+
+        # union == merge of the same content split in two
+        split = rng.randrange(0, len(naive) + 1)
+        b1, b2 = RangeDepsBuilder(), RangeDepsBuilder()
+        for i, (r, t) in enumerate(naive):
+            (b1 if i < split else b2).add(r, t)
+        u = b1.build().union(b2.build())
+        m = RangeDeps.merge([b1.build(), b2.build()])
+        for k in rng.sample(range(0, 105), 8):
+            assert u.for_key(k) == naive_for_key(k)
+            assert m.for_key(k) == naive_for_key(k)
+
+
+def test_deps_randomized_vs_naive():
+    """Combined key+range Deps: union/slice/without/participants_of against
+    naive models."""
+    rng = random.Random(13)
+    for trial in range(20):
+        key_naive = {}
+        range_naive = []
+        kb, rb = KeyDepsBuilder(), RangeDepsBuilder()
+        for _ in range(rng.randrange(0, 40)):
+            t = tid(rng.randrange(20), rng.randrange(3))
+            if rng.random() < 0.6:
+                k = rng.randrange(12)
+                key_naive.setdefault(k, set()).add(t)
+                kb.add(k, t)
+            else:
+                s = rng.randrange(0, 30)
+                r = Range(s, s + 1 + rng.randrange(8))
+                range_naive.append((r, t))
+                rb.add(r, t)
+        d = Deps(kb.build(), rb.build())
+
+        def naive_for_key(k):
+            out = set(key_naive.get(k, set()))
+            out |= {t for r, t in range_naive if r.start <= k < r.end}
+            return tuple(sorted(out))
+
+        for k in range(0, 34):
+            assert d.for_key(k) == naive_for_key(k), f"trial {trial} key {k}"
+
+        all_ids = {t for ts in key_naive.values() for t in ts} \
+            | {t for _, t in range_naive}
+        assert d.all_txn_ids() == tuple(sorted(all_ids))
+        for t in sorted(all_ids)[:6]:
+            assert d.contains(t)
+            parts = d.participants_of(t)
+            # every key the id was attached to must be covered
+            for k, ts in key_naive.items():
+                if t in ts:
+                    assert parts is not None and k in tuple(parts), \
+                        f"trial {trial}: {t} lost key {k}"
+
+        cut = rng.randrange(20)
+        wo = d.without(lambda t: t.hlc < cut)
+        for k in range(0, 34):
+            assert wo.for_key(k) == tuple(
+                t for t in naive_for_key(k) if not t.hlc < cut)
+
+        s = rng.randrange(0, 25)
+        window = Ranges.of(Range(s, s + 10))
+        sliced = d.slice(window)
+        for k in range(0, 40):
+            expect = naive_for_key(k) if s <= k < s + 10 else ()
+            assert sliced.for_key(k) == expect, f"trial {trial} slice {k}"

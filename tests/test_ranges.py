@@ -92,3 +92,36 @@ def test_range_reads_device_async_deterministic():
                  config=cfg())
     assert a.acked == 80 and a.lost == 0
     assert a.log == b.log
+
+
+def test_range_write_burn():
+    """Range-domain WRITES through the RangeDeps machinery (VERDICT r4 item
+    8: the burn previously generated range READS only)."""
+    r = run_burn(5, ops=200, range_read_ratio=0.1, range_write_ratio=0.2,
+                 write_ratio=0.6)
+    assert r.acked == 200 and r.lost == 0
+
+
+def test_range_writes_with_durability_truncation():
+    r = run_burn(9, ops=300, range_read_ratio=0.1, range_write_ratio=0.2,
+                 config=ClusterConfig(durability=True,
+                                      durability_interval_ms=400.0))
+    assert r.acked == 300 and r.lost == 0
+
+
+@pytest.mark.parametrize("seed", (4, 11, 19))
+def test_range_writes_under_churn_chaos(seed):
+    cfg = ClusterConfig(num_nodes=4, rf=3, timeout_ms=4000.0,
+                        preaccept_timeout_ms=4000.0)
+    r = run_burn(seed, ops=250, range_read_ratio=0.1, range_write_ratio=0.2,
+                 topology_churn=True, churn_interval_ms=1000.0,
+                 chaos_drop=0.05, chaos_partitions=True, config=cfg)
+    assert r.lost == 0
+
+
+def test_range_writes_deterministic():
+    kw = dict(ops=150, range_read_ratio=0.1, range_write_ratio=0.2,
+              collect_log=True)
+    a = run_burn(6, **kw)
+    b = run_burn(6, **kw)
+    assert a.log == b.log

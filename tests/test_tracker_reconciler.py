@@ -1,0 +1,192 @@
+"""Exhaustive tracker state-space reconciliation vs brute-force oracles.
+
+Mirrors the reference's TrackerReconcilerTest (test coordinate/tracking/
+TrackerReconcilerTest.java): for every assignment of per-node outcomes and
+every delivery order over small topologies, the tracker's first decision --
+its type AND the event on which it fires -- must match an oracle computed
+directly from the quorum arithmetic, and the decision must be stable
+afterwards (every later event reports NO_CHANGE)."""
+from __future__ import annotations
+
+from itertools import permutations, product
+
+from accord_tpu.coordinate.tracking import (
+    AppliedTracker, FastPathTracker, InvalidationTracker, QuorumTracker,
+    RecoveryTracker, RequestStatus,
+)
+from accord_tpu.primitives.keyspace import Keys, Range
+from accord_tpu.topology.shard import Shard
+from accord_tpu.topology.topologies import Topologies
+from accord_tpu.topology.topology import Topology
+
+FAST, SLOW, FAIL = "fast", "slow", "fail"
+
+TOPOLOGIES = {
+    "rf3": Topologies.single(Topology(1, [Shard(Range(0, 100), [1, 2, 3])])),
+    "rf5": Topologies.single(Topology(1, [Shard(Range(0, 100),
+                                               [1, 2, 3, 4, 5])])),
+    "2shard": Topologies.single(Topology(1, [
+        Shard(Range(0, 50), [1, 2, 3]),
+        Shard(Range(50, 100), [3, 4, 5]),
+    ])),
+}
+
+
+def _enumerate(nodes, outcomes):
+    """Every outcome assignment x every delivery order. rf5 keeps full
+    assignment coverage but caps orders (5! x 3^5 is fine; keep all)."""
+    for assignment in product(outcomes, repeat=len(nodes)):
+        by_node = dict(zip(nodes, assignment))
+        for order in permutations(nodes):
+            yield by_node, order
+
+
+class _Oracle:
+    """Brute-force per-shard accounting mirroring the documented criteria."""
+
+    def __init__(self, topologies):
+        self.shards = [s for t in topologies for s in t.shards]
+        self.success = {id(s): set() for s in self.shards}
+        self.failure = {id(s): set() for s in self.shards}
+        self.fast = {id(s): set() for s in self.shards}
+        self.slow = {id(s): set() for s in self.shards}  # replied, no fast vote
+
+    def feed(self, node, outcome):
+        for s in self.shards:
+            if node not in s.nodes:
+                continue
+            if outcome == FAIL:
+                self.failure[id(s)].add(node)
+            else:
+                self.success[id(s)].add(node)
+                if outcome == FAST:
+                    self.fast[id(s)].add(node)
+                else:
+                    self.slow[id(s)].add(node)
+
+    def failed(self):
+        return any(len(self.failure[id(s)]) > s.max_failures
+                   for s in self.shards)
+
+    def quorum(self):
+        return all(len(self.success[id(s)]) >= s.slow_path_quorum_size
+                   for s in self.shards)
+
+    def fast_resolved(self, s):
+        e = s.fast_path_electorate
+        votes = len(self.fast[id(s)] & e)
+        rejected = len((self.slow[id(s)] | self.failure[id(s)]) & e)
+        pending = len(e) - votes - rejected
+        achieved = votes >= s.fast_path_quorum_size
+        impossible = votes + pending < s.fast_path_quorum_size
+        return achieved or impossible
+
+    def fast_all_resolved(self):
+        return all(self.fast_resolved(s) for s in self.shards)
+
+
+def _reconcile(name, topologies, make_tracker, feed, is_success, outcomes):
+    nodes = tuple(sorted({n for t in topologies for s in t.shards
+                          for n in s.nodes}))
+    checked = 0
+    for by_node, order in _enumerate(nodes, outcomes):
+        tracker = make_tracker(topologies)
+        oracle = _Oracle(topologies)
+        decided = None
+        for step, node in enumerate(order):
+            outcome = by_node[node]
+            got = feed(tracker, node, outcome)
+            oracle.feed(node, outcome)
+            if decided is None:
+                expect = (RequestStatus.FAILED if oracle.failed()
+                          else RequestStatus.SUCCESS
+                          if is_success(oracle) else None)
+                if expect is not None:
+                    assert got == expect, (
+                        f"{name} {by_node} order={order} step {step}: "
+                        f"got {got}, oracle says {expect}")
+                    decided = expect
+                else:
+                    assert got == RequestStatus.NO_CHANGE, (
+                        f"{name} {by_node} order={order} step {step}: "
+                        f"premature {got}")
+            else:
+                # decision is sticky: no event may flip or re-fire it
+                assert got == RequestStatus.NO_CHANGE, (
+                    f"{name} {by_node} order={order} step {step}: "
+                    f"{got} after {decided}")
+            assert tracker.decided == decided
+        checked += 1
+    assert checked > 0
+
+
+def _feed_plain(tracker, node, outcome):
+    if outcome == FAIL:
+        return tracker.on_failure(node)
+    return tracker.on_success(node)
+
+
+def _feed_voting(tracker, node, outcome):
+    if outcome == FAIL:
+        return tracker.on_failure(node)
+    return tracker.on_success(node, outcome == FAST)
+
+
+def test_quorum_tracker_reconciles():
+    for tname, topo in TOPOLOGIES.items():
+        for cls in (QuorumTracker, AppliedTracker):
+            _reconcile(f"{cls.__name__}/{tname}", topo, lambda t: cls(t),
+                       _feed_plain, _Oracle.quorum, (SLOW, FAIL))
+
+
+def test_fast_path_tracker_reconciles():
+    """Success needs quorum AND the fast path resolved (achieved or dead) in
+    every shard -- the tracker must never conclude while fast is undecided."""
+    for tname, topo in TOPOLOGIES.items():
+        _reconcile(
+            f"FastPath/{tname}", topo, lambda t: FastPathTracker(t),
+            _feed_voting,
+            lambda o: o.quorum() and o.fast_all_resolved(),
+            (FAST, SLOW, FAIL))
+
+
+def test_recovery_tracker_reconciles():
+    """Success is plain quorum; rejects_fast_path must equal the positive-
+    reject arithmetic at every step."""
+    for tname, topo in TOPOLOGIES.items():
+        nodes = tuple(sorted({n for t in topo for s in t.shards
+                              for n in s.nodes}))
+        for by_node, order in _enumerate(nodes, (FAST, SLOW, FAIL)):
+            tracker = RecoveryTracker(topo)
+            oracle = _Oracle(topo)
+            for node in order:
+                _feed_voting(tracker, node, by_node[node])
+                oracle.feed(node, by_node[node])
+                expect = any(
+                    s.rejects_fast_path(
+                        len(oracle.slow[id(s)] & s.fast_path_electorate))
+                    for s in oracle.shards)
+                assert tracker.rejects_fast_path() == expect, \
+                    f"{tname} {by_node} order={order}"
+
+
+def test_invalidation_tracker_reconciles():
+    """Success is the promise quorum; is_fast_path_rejected must equal the
+    positive-reject arithmetic (failures excluded) at every step."""
+    for tname, topo in TOPOLOGIES.items():
+        key = Keys([60]) if tname == "2shard" else Keys([10])
+        nodes = tuple(sorted({n for t in topo for s in t.shards
+                              for n in s.nodes}))
+        for by_node, order in _enumerate(nodes, (FAST, SLOW, FAIL)):
+            tracker = InvalidationTracker(topo, key, fast_path_epoch=1)
+            fast_shards = [s for t in topo for s in t.shards_for(key)]
+            oracle = _Oracle(topo)
+            for node in order:
+                _feed_voting(tracker, node, by_node[node])
+                oracle.feed(node, by_node[node])
+                expect = bool(fast_shards) and all(
+                    s.rejects_fast_path(
+                        len(oracle.slow[id(s)] & s.fast_path_electorate))
+                    for s in fast_shards)
+                assert tracker.is_fast_path_rejected() == expect, \
+                    f"{tname} {by_node} order={order}"
