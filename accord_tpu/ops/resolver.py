@@ -325,18 +325,27 @@ class _NodeArena:
         inflight). Exactness: key_rows bits track REAL key sets, so bucket
         collisions and cross-store rows drop out here; invalid rows were
         already excluded by the kernel's valid lane."""
-        from accord_tpu.primitives.deps import KeyDeps
-        srow = self.row_of.get(txn_id)
-        if srow is not None and (prow[srow >> 5] >> np.uint32(srow & 31)) & 1:
-            prow = prow.copy()
-            prow[srow >> 5] &= np.uint32(~(1 << (srow & 31)) & 0xFFFFFFFF)
         wnz = np.nonzero(prow)[0]
         if wnz.size == 0:
+            from accord_tpu.primitives.deps import KeyDeps
             return KeyDeps.EMPTY
         sub = np.unpackbits(prow[wnz].astype("<u4").view(np.uint8),
                             bitorder="little").reshape(wnz.size, 32)
         rr, cc = np.nonzero(sub)
         rows_all = (wnz[rr].astype(np.int64) << 5) | cc
+        return self.decode_rows(txn_id, owned_keys, rows_all)
+
+    def decode_rows(self, txn_id: TxnId, owned_keys, rows_all: np.ndarray):
+        """CSR recovery from already-extracted dep row indices (the batched
+        harvest unpacks the WHOLE dispatch's bit matrix in one numpy call
+        and hands each subject its row list -- per-subject numpy-call
+        overhead was the decode bottleneck at large dispatch sizes)."""
+        from accord_tpu.primitives.deps import KeyDeps
+        srow = self.row_of.get(txn_id)
+        if srow is not None and rows_all.size:
+            rows_all = rows_all[rows_all != srow]
+        if rows_all.size == 0:
+            return KeyDeps.EMPTY
         hi = rows_all >> 5
         lo = rows_all & 31
         keys = []
@@ -463,7 +472,12 @@ class _Call:
 class BatchDepsResolver(DepsResolver):
     MAX_DISPATCH = 64   # subjects per kernel call (keeps jit tiers bounded)
 
-    def __init__(self, num_buckets: int = 256, initial_cap: int = 4096):
+    def __init__(self, num_buckets: int = 256, initial_cap: int = 4096,
+                 max_dispatch: Optional[int] = None):
+        # each dispatch pays one interconnect round trip at harvest, so on
+        # high-latency links (the tunnelled bench chip) larger dispatches
+        # amortize it; the default stays small to bound jit tiers in tests
+        self.max_dispatch = max_dispatch or self.MAX_DISPATCH
         import jax.numpy as jnp
         self.num_buckets = num_buckets
         self.initial_cap = initial_cap
@@ -568,9 +582,9 @@ class BatchDepsResolver(DepsResolver):
         for (store, t, ks, before, out) in dq:
             items.append(_Item(store, t, store.owned(ks), before, out))
         # split oversized batches so subject-bucket jit tiers stay bounded
-        # (8..MAX_DISPATCH); each slice is its own pipelined call
-        for lo in range(0, len(items), self.MAX_DISPATCH):
-            self._dispatch(node, items[lo:lo + self.MAX_DISPATCH])
+        # (8..max_dispatch); each slice is its own pipelined call
+        for lo in range(0, len(items), self.max_dispatch):
+            self._dispatch(node, items[lo:lo + self.max_dispatch])
 
     def _encode_and_run(self, arena: _NodeArena, items: List[_Item]):
         """Chunk subjects, build the compact upload arrays, run the fused
@@ -607,12 +621,21 @@ class BatchDepsResolver(DepsResolver):
         return deps_resolve(sk, sb, sknd,
                             act_bm, act_ts, act_kinds, act_valid, self._table)
 
-    def _decode_item(self, arena: _NodeArena, item: _Item, packed) -> Deps:
+    def _decode_item(self, arena: _NodeArena, item: _Item, packed,
+                     bits=None) -> Deps:
         """Recover one subject's exact key-domain deps from the bit-packed
-        kernel result. Shared by harvest and the sync path."""
+        kernel result. Shared by harvest and the sync path. `bits` is the
+        dispatch-wide pre-unpacked bool matrix when the caller batched the
+        unpack (the harvest path)."""
         from accord_tpu.primitives.deps import KeyDeps
         if packed is None:
             kd = KeyDeps.EMPTY
+        elif bits is not None:
+            brow = bits[item.chunks[0]]
+            for c in item.chunks[1:]:
+                brow = brow | bits[c]
+            kd = arena.decode_rows(item.txn_id, sorted(item.owned),
+                                   np.nonzero(brow)[0].astype(np.int64))
         else:
             prow = packed[item.chunks[0]]
             for c in item.chunks[1:]:
@@ -652,11 +675,18 @@ class BatchDepsResolver(DepsResolver):
         import time as _time
         stale = call.gen != call.arena.gen
         packed = None
+        bits = None
         if call.packed is not None and not stale:
             t0 = _time.perf_counter()
             packed = np.asarray(call.packed)
             self.harvest_stall_s += _time.perf_counter() - t0
         t0 = _time.perf_counter()
+        if packed is not None:
+            # one dispatch-wide unpack: per-subject numpy-call overhead is
+            # what dominates the decode at large dispatch sizes
+            bits = np.unpackbits(
+                np.ascontiguousarray(packed).astype("<u4", copy=False)
+                .view(np.uint8), bitorder="little", axis=1)
         results = []
         for item in call.items:
             store = item.store
@@ -669,7 +699,7 @@ class BatchDepsResolver(DepsResolver):
                 results.append(store.inject_dep_floor(
                     item.txn_id, item.owned, raw, item.before))
                 continue
-            deps = self._decode_item(call.arena, item, packed)
+            deps = self._decode_item(call.arena, item, packed, bits)
             if store.range_txns:
                 deps = deps.union(store.host_range_deps(
                     item.txn_id, item.owned, item.before))

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Maelstrom --bin wrapper for the accord_tpu node (reference:
+# accord-maelstrom Main.java:60). Maelstrom execs one copy per node and
+# speaks JSON lines over stdin/stdout; logs go to stderr.
+#
+#   maelstrom test -w txn-list-append --bin "$(pwd)/maelstrom/serve.sh" \
+#       --node-count 3 --time-limit 30 --rate 100
+#
+# The script resolves the repo root from its own location so maelstrom can
+# exec it from any working directory.
+set -euo pipefail
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="${REPO_ROOT}${PYTHONPATH:+:${PYTHONPATH}}"
+exec python3 -m accord_tpu.maelstrom "$@"

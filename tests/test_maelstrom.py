@@ -30,12 +30,18 @@ def test_runner_deterministic():
     assert a == b
 
 
+_SERVE_SH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "maelstrom", "serve.sh")
+
+
 class _Proc:
     def __init__(self, node_id: str, router):
         env = dict(os.environ)
         self.node_id = node_id
+        # exec the shipped --bin wrapper itself (what `maelstrom test -w
+        # txn-list-append --bin maelstrom/serve.sh` would run per node)
         self.proc = subprocess.Popen(
-            [sys.executable, "-m", "accord_tpu.maelstrom"],
+            [_SERVE_SH],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL, text=True, env=env)
         self.router = router
