@@ -24,8 +24,9 @@ from accord_tpu.coordinate.tracking import (
     AppliedTracker, FastPathTracker, QuorumTracker, ReadTracker, RequestStatus,
 )
 from accord_tpu.messages import (
-    Accept, AcceptNack, AcceptOk, Apply, ApplyOk, Callback, Commit, CommitOk,
-    PreAccept, PreAcceptNack, PreAcceptOk, ReadNack, ReadOk, ReadTxnData,
+    Accept, AcceptNack, AcceptOk, AcceptRedundant, Apply, ApplyOk, Callback,
+    Commit, CommitOk, PreAccept, PreAcceptNack, PreAcceptOk, ReadNack, ReadOk,
+    ReadTxnData,
 )
 from accord_tpu.primitives.deps import Deps
 from accord_tpu.primitives.routes import Route
@@ -282,6 +283,15 @@ class _ProposeRound(Callback):
 
     def on_success(self, from_node, reply) -> None:
         if self.parent.done or self.tracker.decided is not None:
+            return
+        if isinstance(reply, AcceptRedundant):
+            # the txn is already COMMITTED (a recovery superseded us, possibly
+            # at a different executeAt): committing OUR proposal would hand
+            # the client a result computed at the wrong timestamp. Fail as
+            # preempted; the cluster already carries the decided outcome.
+            self.parent._fail(Preempted(
+                f"{self.parent.txn_id} already committed at "
+                f"{reply.execute_at}"))
             return
         if isinstance(reply, AcceptNack):
             self.nacked = True

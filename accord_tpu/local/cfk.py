@@ -46,7 +46,8 @@ class CfkInfo:
 
 
 class CommandsForKey:
-    __slots__ = ("key", "_infos", "_sorted", "max_applied_write")
+    __slots__ = ("key", "_infos", "_sorted", "max_applied_write",
+                 "covered", "cover_watermark")
 
     def __init__(self, key):
         self.key = key
@@ -54,6 +55,24 @@ class CommandsForKey:
         self._sorted: Optional[List[TxnId]] = []
         # highest applied write executeAt for read-timestamp validation
         self.max_applied_write: Optional[Timestamp] = None
+        # transitive-dependency elision (reference: CommandsForKey.java
+        # "Transitive Dependency Elision", :146-151): ids PROVEN covered by a
+        # committed write at this key -- a subject that depends on the
+        # covering write is transitively ordered after them, so the scan
+        # elides them from new dep sets. Unlike the reference (which executes
+        # per-key in executeAt order) the repo executes by the agreed wait
+        # graph, where an edge A->B only exists for B.executeAt < A.executeAt;
+        # covering therefore requires BOTH that the id is in the cover's
+        # agreed deps AND that it committed with executeAt below the cover's
+        # (so the cover really waits it). Maps id -> (cover_seq, cover
+        # executeAt): elision applies only to subjects whose started-before
+        # bound is above the cover's executeAt -- the subject's own executeAt
+        # (>= bound) then lands above the cover so its wait edge to the
+        # cover is real, and executeAt >= txnId keeps the cover inside the
+        # emitted dep set. cover_seq (the store's monotone cover counter)
+        # lets the async device path elide only covers that existed when its
+        # kernel snapshot was taken.
+        self.covered: Dict[TxnId, Tuple[int, Timestamp]] = {}
 
     # -- registration --------------------------------------------------------
     def update(self, txn_id: TxnId, status: CfkStatus,
@@ -75,7 +94,26 @@ class CommandsForKey:
     def remove(self, txn_id: TxnId) -> None:
         if txn_id in self._infos:
             del self._infos[txn_id]
+            self.covered.pop(txn_id, None)
             self._sorted = None
+
+    def mark_covered(self, cover_seq: int, cover_id: TxnId,
+                     cover_exec: Timestamp, dep_ids) -> None:
+        """`cover_id` (a WRITE at this key) committed at `cover_exec` with
+        agreed deps `dep_ids` at this key. An id is covered only when its
+        OWN executeAt is decided and below the cover's: only then does the
+        cover's wait graph really include it (see class comment)."""
+        for t in dep_ids:
+            if t in self.covered:
+                continue
+            info = self._infos.get(t)
+            if info is None \
+                    or info.status not in (CfkStatus.COMMITTED,
+                                           CfkStatus.APPLIED) \
+                    or info.execute_at is None \
+                    or not info.execute_at < cover_exec:
+                continue
+            self.covered[t] = (cover_seq, cover_exec)
 
     def prune_below(self, floor: Timestamp) -> List[TxnId]:
         """Drop APPLIED/INVALIDATED entries wholly below `floor` (the
@@ -92,6 +130,7 @@ class CommandsForKey:
             and (info.execute_at is None or info.execute_at < floor)]
         for t in pruned:
             del self._infos[t]
+            self.covered.pop(t, None)
         if pruned:
             self._sorted = None
         return pruned
@@ -115,8 +154,14 @@ class CommandsForKey:
         """All witnessed txn ids t != subject with t < before that `subject`'s
         kind witnesses and that may still execute (not invalidated). This is
         the deps-calculation scan (reference mapReduceActive semantics:
-        STARTED_BEFORE(before) + kind filter)."""
+        STARTED_BEFORE(before) + kind filter), with transitive-dependency
+        elision: ids covered by a committed write's agreed deps are dropped
+        whenever every covering write is itself below `before` (and hence in
+        the emitted set) -- this is what keeps dep sets bounded by the
+        conflicts since the last committed write instead of the full
+        conflict count between durability rounds."""
         kind = subject.kind
+        covered = self.covered
         for t in self._ids():
             if not t < before:
                 break
@@ -124,6 +169,9 @@ class CommandsForKey:
                 continue
             info = self._infos[t]
             if info.status == CfkStatus.INVALIDATED:
+                continue
+            cov = covered.get(t)
+            if cov is not None and cov[1] < before:
                 continue
             if kind.witnesses(t.kind):
                 yield t

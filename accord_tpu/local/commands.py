@@ -19,7 +19,9 @@ from accord_tpu.local.store import CommandStore
 from accord_tpu.primitives.deps import Deps
 from accord_tpu.primitives.keyspace import Keys, Ranges
 from accord_tpu.primitives.routes import Route
-from accord_tpu.primitives.timestamp import Ballot, Timestamp, TxnId, TxnKind
+from accord_tpu.primitives.timestamp import (
+    Ballot, Domain, Timestamp, TxnId, TxnKind,
+)
 from accord_tpu.primitives.txn import PartialTxn
 from accord_tpu.primitives.writes import Writes
 from accord_tpu.utils.invariants import Invariants
@@ -210,6 +212,10 @@ def commit(store: CommandStore, txn_id: TxnId, route: Route, txn: Optional[Parti
     cmd.status = Status.STABLE
     store.register(txn_id, cmd.txn.keys, CfkStatus.COMMITTED,
                    max(execute_at, txn_id.as_timestamp()), execute_at)
+    if txn_id.kind is TxnKind.WRITE and txn_id.domain is Domain.KEY:
+        # transitive-dependency elision: the deps this write really waits
+        # for are now covered by a single dep on it
+        store.register_commit_cover(txn_id, execute_at, deps)
     _init_waiting_on(store, cmd)
     if store.exec_plane is not None:
         store.exec_plane.on_stable(cmd)

@@ -61,6 +61,10 @@ class CommandStore:
         # instead of linear scans
         from accord_tpu.utils.interval_index import IntervalIndex
         self.range_index = IntervalIndex()
+        # monotone counter of commit-cover events (transitive-dependency
+        # elision): stamps cfk cover entries so the async device decode can
+        # scope elision to covers its kernel snapshot saw
+        self.cover_seq = 0
         # max witnessed conflict per exact key (hot path: O(1) updates);
         # range-domain txns land in the range map (rare, merged on query)
         self.max_conflicts_by_key: Dict[Key, Timestamp] = {}
@@ -867,7 +871,17 @@ class CommandStore:
             reach commit before recovery can safely propose the fast path).
 
         Returns (rejects_fast_path, earlier_committed_witness: Deps,
-        earlier_accepted_no_witness: Deps)."""
+        earlier_accepted_no_witness: Deps).
+
+        Witness checks are THREE-VALUED under transitive-dependency elision:
+        a candidate's deps may carry txn_id only via a committed write whose
+        agreed deps include it (the cover chain). True = proven witnessed,
+        False = proven not (every chain locally resolvable), None = unknown
+        (a chain element is not committed here, so an elision made at
+        another replica could hide txn_id behind it). Each flag takes its
+        SAFE direction: `rejects` (enables invalidation) requires proof of
+        non-witness; `ecw` requires proof of witness; `eanw` (forces an
+        await) includes anything not proven witnessed."""
         rejects = False
         ecw = KeyDepsBuilder()
         eanw = KeyDepsBuilder()
@@ -896,20 +910,71 @@ class CommandStore:
                     continue  # no proposal/decision to inspect yet
                 has_proposal = cmd.status.has_been(Status.ACCEPTED)
                 is_stable = cmd.status.is_stable
-                witnesses_us = cmd.deps.contains_for(k, txn_id)
+                w = self._witness_status(k, cmd.deps, txn_id, set())
                 if cand > txn_id:
-                    if has_proposal and not witnesses_us:
+                    if has_proposal and w is False:
                         rejects = True
                 else:  # started before us
-                    if is_stable and witnesses_us:
+                    if is_stable and w is True:
                         ecw.add(k, cand)
-                    elif has_proposal and not is_stable and not witnesses_us \
+                    elif has_proposal and not is_stable and w is not True \
                             and cmd.execute_at is not None and cmd.execute_at > tau:
                         eanw.add(k, cand)
-                if is_stable and not witnesses_us \
+                if is_stable and w is False \
                         and cmd.execute_at is not None and cmd.execute_at > tau:
                     rejects = True
         return rejects, Deps(ecw.build()), Deps(eanw.build())
+
+    def _witness_status(self, k, deps: Deps, target: TxnId,
+                        visited: set) -> Optional[bool]:
+        """Does `deps` witness `target` at key k, through committed-cover
+        chains? True/False are proofs; None = unresolvable locally (see
+        recovery_info doc). A cover of `target` is a committed WRITE whose
+        executeAt is above target's -- by TXN ID it may sort either side of
+        target (a slow-path cover's id can be lower), so the walk filters by
+        executeAt, not id order."""
+        if deps.contains_for(k, target):
+            return True
+        tau = target.as_timestamp()
+        unknown = False
+        for d in deps.for_key(k):
+            if d == target or not d.kind.is_write or d in visited:
+                continue
+            visited.add(d)
+            dcmd = self.commands.get(d)
+            if dcmd is not None and dcmd.deps is not None \
+                    and dcmd.status.has_been(Status.COMMITTED) \
+                    and not dcmd.is_(Status.INVALIDATED):
+                if dcmd.execute_at is None or not dcmd.execute_at > tau:
+                    continue  # executes at/below target: cannot cover it
+                sub = self._witness_status(k, dcmd.deps, target, visited)
+                if sub is True:
+                    return True
+                if sub is None:
+                    unknown = True
+            else:
+                # a write dep not committed locally: an elision made at the
+                # replica that resolved `deps` could hide target behind it
+                unknown = True
+        return None if unknown else False
+
+    def register_commit_cover(self, txn_id: TxnId, execute_at: Timestamp,
+                              deps: Deps) -> None:
+        """A key-domain WRITE committed with agreed `deps`: mark each per-key
+        dep it REALLY waits for (committed, lower executeAt) as transitively
+        covered by it (reference: the cfk's transitive dependency elision,
+        CommandsForKey.java:146-151). Future subjects that take the write as
+        a dep are ordered after everything in its wait graph, so the scan
+        may elide them. The monotone cover_seq stamps each cover so the
+        async device decode can ignore covers younger than its kernel
+        snapshot (the covering write would be missing from the reply)."""
+        self.cover_seq += 1
+        for k, ids in deps.key_deps.items():
+            if not self.ranges.contains_key(k):
+                continue
+            c = self.cfks.get(k)
+            if c is not None:
+                c.mark_covered(self.cover_seq, txn_id, execute_at, ids)
 
     # -- registration (feeds the conflict registry) -------------------------
     def register(self, txn_id: TxnId, seekables: Seekables, status: CfkStatus,

@@ -1,6 +1,6 @@
 from accord_tpu.messages.base import Request, Reply, Callback, SimpleReply
 from accord_tpu.messages.preaccept import PreAccept, PreAcceptOk, PreAcceptNack
-from accord_tpu.messages.accept import Accept, AcceptOk, AcceptNack
+from accord_tpu.messages.accept import Accept, AcceptOk, AcceptNack, AcceptRedundant
 from accord_tpu.messages.commit import Commit, CommitOk
 from accord_tpu.messages.apply_msg import Apply, ApplyOk
 from accord_tpu.messages.read import ReadTxnData, ReadOk, ReadNack
@@ -21,7 +21,7 @@ from accord_tpu.messages.inform import (
 __all__ = [
     "Request", "Reply", "Callback", "SimpleReply",
     "PreAccept", "PreAcceptOk", "PreAcceptNack",
-    "Accept", "AcceptOk", "AcceptNack",
+    "Accept", "AcceptOk", "AcceptNack", "AcceptRedundant",
     "Commit", "CommitOk", "Apply", "ApplyOk",
     "ReadTxnData", "ReadOk", "ReadNack",
     "BeginRecovery", "RecoverOk", "RecoverNack", "DepsEntry", "DepsTier",

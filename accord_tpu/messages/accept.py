@@ -41,6 +41,14 @@ class Accept(Request):
                                           store.command(self.txn_id).promised))
             if outcome == AcceptOutcome.TRUNCATED:
                 return success(AcceptNack(self.txn_id, None))
+            if outcome == AcceptOutcome.REDUNDANT:
+                # the txn is already COMMITTED here (a recovery superseded
+                # this proposal): answering AcceptOk would let a stale
+                # coordinator commit ITS executeAt over the decided one and
+                # hand its client a divergent result (observed as the burn's
+                # own-write violation) -- report the decision instead
+                cmd = store.command(self.txn_id)
+                return success(AcceptRedundant(self.txn_id, cmd.execute_at))
             # deps up to executeAt, micro-batched onto the device tick
             return store.calculate_deps_async(
                 self.txn_id, store.owned(self.keys), self.execute_at) \
@@ -49,7 +57,7 @@ class Accept(Request):
         def finish(parts):
             reply = None
             for part in parts:
-                if isinstance(part, AcceptNack):
+                if isinstance(part, (AcceptNack, AcceptRedundant)):
                     reply = part
                     break
                 reply = part if reply is None \
@@ -83,3 +91,18 @@ class AcceptNack(Reply):
 
     def __repr__(self):
         return f"AcceptNack({self.txn_id!r}, promised={self.promised!r})"
+
+
+class AcceptRedundant(Reply):
+    """The txn was already committed (at `execute_at`) when this proposal
+    arrived: the proposer must not commit its own executeAt (reference:
+    AcceptReply.Redundant carrying the superseding decision)."""
+
+    __slots__ = ("txn_id", "execute_at")
+
+    def __init__(self, txn_id: TxnId, execute_at):
+        self.txn_id = txn_id
+        self.execute_at = execute_at
+
+    def __repr__(self):
+        return f"AcceptRedundant({self.txn_id!r}@{self.execute_at!r})"

@@ -316,7 +316,8 @@ class _NodeArena:
         if kr is not None:
             kr[row >> 5] &= np.uint32(~(1 << (row & 31)) & 0xFFFFFFFF)
 
-    def decode_packed(self, txn_id: TxnId, owned_keys, prow: np.ndarray):
+    def decode_packed(self, txn_id: TxnId, owned_keys, prow: np.ndarray,
+                      store=None, before=None, cover_seq=0):
         """Vectorized CSR recovery, O(deps) not O(cap): unpack only the
         NONZERO words of the subject's packed dependency row once, then test
         each key's membership with packed-bit gathers over that small row
@@ -333,13 +334,17 @@ class _NodeArena:
                             bitorder="little").reshape(wnz.size, 32)
         rr, cc = np.nonzero(sub)
         rows_all = (wnz[rr].astype(np.int64) << 5) | cc
-        return self.decode_rows(txn_id, owned_keys, rows_all)
+        return self.decode_rows(txn_id, owned_keys, rows_all, store, before,
+                                cover_seq)
 
-    def decode_rows(self, txn_id: TxnId, owned_keys, rows_all: np.ndarray):
+    def decode_rows(self, txn_id: TxnId, owned_keys, rows_all: np.ndarray,
+                    store=None, before=None, cover_seq=0):
         """CSR recovery from already-extracted dep row indices (the batched
         harvest unpacks the WHOLE dispatch's bit matrix in one numpy call
         and hands each subject its row list -- per-subject numpy-call
-        overhead was the decode bottleneck at large dispatch sizes)."""
+        overhead was the decode bottleneck at large dispatch sizes).
+        `store`/`before` enable the transitive-dependency elision filter so
+        the device path matches the host scan's covered-id rule exactly."""
         from accord_tpu.primitives.deps import KeyDeps
         srow = self.row_of.get(txn_id)
         if srow is not None and rows_all.size:
@@ -350,11 +355,29 @@ class _NodeArena:
         lo = rows_all & 31
         keys = []
         per_key_rows = []
+        cfks = store.cfks if store is not None else {}
         for k in owned_keys:
             kr = self.key_rows.get(k)
             if kr is None:
                 continue
             sel = rows_all[((kr[hi] >> lo) & 1).astype(bool)]
+            if sel.size and before is not None:
+                c = cfks.get(k)
+                if c is not None and c.covered:
+                    cov = c.covered
+                    ids = self.ids_np
+
+                    def live(r):
+                        e = cov.get(ids[r])
+                        # elide only covers the kernel snapshot already saw
+                        # (seq <= cover_seq) whose cover executes below the
+                        # subject's bound -- the host scan's exact rule plus
+                        # the snapshot guard
+                        return e is None or e[0] > cover_seq \
+                            or not e[1] < before
+
+                    mask = np.fromiter((live(r) for r in sel), bool, sel.size)
+                    sel = sel[mask]
             if sel.size:
                 keys.append(k)
                 per_key_rows.append(sel)
@@ -447,7 +470,7 @@ class _Item:
     """One queued resolution (a PreAccept's deps or a standalone deps query)."""
 
     __slots__ = ("store", "txn_id", "owned", "before", "out", "outcome",
-                 "chunks")
+                 "chunks", "cover_seq")
 
     def __init__(self, store, txn_id, owned, before, out, outcome=None):
         self.store = store
@@ -457,6 +480,10 @@ class _Item:
         self.out = out              # AsyncResult
         self.outcome = outcome      # preaccept outcome (None for deps query)
         self.chunks: List[int] = []  # subject-row indices in the dispatch
+        # set at encode time: covers younger than this were invisible to the
+        # kernel snapshot, so the decode must not elide by them (the covering
+        # write would be missing from the reply)
+        self.cover_seq = 0
 
 
 class _Call:
@@ -596,6 +623,7 @@ class BatchDepsResolver(DepsResolver):
         subj_before: List[Timestamp] = []
         subj_kinds: List[int] = []
         for item in items:
+            item.cover_seq = item.store.cover_seq
             ks = sorted(int(k) for k in item.owned)
             for lo in range(0, max(len(ks), 1), _NodeArena.MAXK):
                 chunk = ks[lo:lo + _NodeArena.MAXK]
@@ -635,17 +663,20 @@ class BatchDepsResolver(DepsResolver):
             for c in item.chunks[1:]:
                 brow = brow | bits[c]
             kd = arena.decode_rows(item.txn_id, sorted(item.owned),
-                                   np.nonzero(brow)[0].astype(np.int64))
+                                   np.nonzero(brow)[0].astype(np.int64),
+                                   item.store, item.before, item.cover_seq)
         else:
             prow = packed[item.chunks[0]]
             for c in item.chunks[1:]:
                 prow = prow | packed[c]
-            kd = arena.decode_packed(item.txn_id, sorted(item.owned), prow)
+            kd = arena.decode_packed(item.txn_id, sorted(item.owned), prow,
+                                     item.store, item.before, item.cover_seq)
         if not arena.host_only:
             return Deps(kd)
         # rows too wide for the device (> MAXK keys) are scanned host-side
         kb = KeyDepsBuilder()
         subj_set = set(item.owned)
+        cfks = item.store.cfks
         for j in arena.host_only:
             if j in arena.invalidated:
                 continue  # host scan excludes invalidated deps too
@@ -653,6 +684,11 @@ class BatchDepsResolver(DepsResolver):
             if dep_id != item.txn_id and dep_id < item.before \
                     and item.txn_id.kind.witnesses(dep_id.kind):
                 for k in arena.key_sets[j] & subj_set:
+                    c = cfks.get(k)
+                    e = c.covered.get(dep_id) if c is not None else None
+                    if e is not None and e[0] <= item.cover_seq \
+                            and e[1] < item.before:
+                        continue  # transitive-dependency elision (cfk rule)
                     kb.add(k, dep_id)
         return Deps(kd.union(kb.build()))
 

@@ -161,9 +161,11 @@ def bench_pipeline(quick: bool):
     chunk = 2 * PIPE_BATCH  # two in-flight dispatches per chunk
     done = [0]
     chunk_walls = []
+    chunk_sizes = []
     replay_t0 = time.perf_counter()
     for base in range(0, replay_ops, chunk):
         n = min(chunk, replay_ops - base)
+        chunk_sizes.append(n)
         c0 = time.perf_counter()
         for _ in range(n):
             ts = node.unique_now()
@@ -177,7 +179,7 @@ def bench_pipeline(quick: bool):
     replay_wall = time.perf_counter() - replay_t0
     if done[0] != replay_ops:
         raise AssertionError(f"large replay resolved {done[0]}/{replay_ops}")
-    per_op = np.asarray(chunk_walls) / chunk * 1e6  # amortized us/subject
+    per_op = np.asarray(chunk_walls) / np.asarray(chunk_sizes) * 1e6
     host_projected_s = replay_ops * (host_mean / 1e6)
 
     return {

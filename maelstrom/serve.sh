@@ -11,4 +11,5 @@
 set -euo pipefail
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 export PYTHONPATH="${REPO_ROOT}${PYTHONPATH:+:${PYTHONPATH}}"
-exec python3 -m accord_tpu.maelstrom "$@"
+# PYTHON override lets test harnesses (and venv users) pin the interpreter
+exec "${PYTHON:-python3}" -m accord_tpu.maelstrom "$@"

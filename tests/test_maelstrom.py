@@ -37,6 +37,7 @@ _SERVE_SH = os.path.join(os.path.dirname(os.path.dirname(
 class _Proc:
     def __init__(self, node_id: str, router):
         env = dict(os.environ)
+        env["PYTHON"] = sys.executable  # pin the test venv's interpreter
         self.node_id = node_id
         # exec the shipped --bin wrapper itself (what `maelstrom test -w
         # txn-list-append --bin maelstrom/serve.sh` would run per node)
