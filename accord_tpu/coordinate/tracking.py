@@ -263,10 +263,15 @@ class InvalidationTracker(FastPathTracker):
         """More REPLIED electorate members cast no ballot-0 fast vote than
         the electorate can spare: no fast quorum ever formed, and our
         promises gate any future vote (reference: isFastPathRejected).
-        Failed members prove nothing and are excluded."""
+        Failed members prove nothing and are excluded. ANY shard rejecting
+        decides: a ballot-0 fast commit needs a fast quorum in EVERY shard,
+        so one decisively dead shard kills the whole fast path (reference
+        InvalidationTracker sets rejectsFastPath per-shard; the all() this
+        replaces was equivalent only while propose_invalidate stayed
+        single-key/single-shard)."""
         if not self._fast_states:
             return False
-        return all(
+        return any(
             st.shard.rejects_fast_path(
                 len((st.fast_rejects & st.shard.fast_path_electorate)
                     - st.failures))

@@ -31,7 +31,7 @@ from accord_tpu.primitives.timestamp import TxnId
 class _Tracked:
     __slots__ = ("txn_id", "participants", "last_status", "last_change_ms",
                  "attempts", "next_attempt_ms", "in_flight", "home", "home_key",
-                 "last_token")
+                 "last_token", "awaited")
 
     def __init__(self, txn_id: TxnId, participants, status: Status, now_ms: float,
                  home: bool = True, home_key=None):
@@ -52,6 +52,10 @@ class _Tracked:
         # and first INFORM the home shard instead of probing themselves
         self.home = home
         self.home_key = home_key
+        # a LOCAL waiter is blocked on this txn (reference BlockedUntil):
+        # chased at full cadence regardless of home ownership -- the
+        # non-home defer exists for orphaned preaccepts nobody waits on
+        self.awaited = False
 
 
 class ProgressEngine:
@@ -85,18 +89,35 @@ class ProgressEngine:
 
     # -- tracking ------------------------------------------------------------
     def track(self, txn_id: TxnId, participants: Optional[Seekables],
-              status: Status, home: bool = True, home_key=None) -> None:
+              status: Status, home: Optional[bool] = True,
+              home_key=None, awaited: bool = False) -> None:
+        """`home=None` means the caller does not know whether this store is
+        the home shard: an existing entry keeps its current home value
+        (no silent promotion to home cadence), a new entry defaults to
+        home -- the conservative cadence for an entry nobody has
+        classified yet. `awaited` marks a txn a local waiter is blocked on:
+        it is chased at full cadence whatever its home classification."""
         now = self.node.now_millis()
         entry = self.tracked.get(txn_id)
         if entry is None:
             if participants is None:
                 return  # nowhere to address a probe yet
-            entry = _Tracked(txn_id, participants, status, now, home, home_key)
+            entry = _Tracked(txn_id, participants, status, now,
+                             home if home is not None else True, home_key)
+            entry.awaited = awaited
             entry.next_attempt_ms = now + self._stall(entry) + self._jitter()
             self.tracked[txn_id] = entry
         else:
             if participants is not None:
                 entry.participants = participants
+            if awaited and not entry.awaited:
+                # a waiter appeared: leave home alone, but pull a deferred
+                # non-home timer in to full cadence -- the blocked dep must
+                # be chased now, not after the orphan defer
+                entry.awaited = True
+                entry.next_attempt_ms = min(
+                    entry.next_attempt_ms,
+                    now + self.stall_ms + self._jitter())
             if home and not entry.home:
                 # another store here owns the home key: promote, and pull the
                 # deferred non-home timer back to home cadence (the first
@@ -116,10 +137,11 @@ class ProgressEngine:
         self._ensure_scheduled()
 
     def _stall(self, entry: _Tracked) -> float:
-        # the defer applies only to non-home UNDECIDED entries (the orphaned-
-        # preaccept net): for decided txns every replica must fetch its own
-        # outcome regardless, so deferring would only slow straggler repair
-        if entry.home or entry.last_status.is_decided:
+        # the defer applies only to non-home UNDECIDED entries nobody waits
+        # on (the orphaned-preaccept net): for decided txns every replica
+        # must fetch its own outcome regardless, and a blocked-on dep must
+        # be chased promptly, so deferring would only slow repair
+        if entry.home or entry.awaited or entry.last_status.is_decided:
             return self.stall_ms
         return self.stall_ms * self.home_defer
 
@@ -495,8 +517,12 @@ class StoreProgressLog(ProgressLog):
         self._track(command, is_home)
 
     def readyToExecute(self, command) -> None:
+        # the caller does not know whether this store is home: home=None
+        # preserves the entry's existing classification instead of silently
+        # promoting a non-home entry to home cadence
         self.engine.track(command.txn_id, self._participants(command),
-                          command.status, home_key=self._home_key(command))
+                          command.status, home=None,
+                          home_key=self._home_key(command))
 
     def executed(self, command, is_home: bool) -> None:
         self._track(command, is_home)
@@ -511,7 +537,11 @@ class StoreProgressLog(ProgressLog):
         self.engine.clear(command.txn_id)
 
     def waiting(self, blocked_by: TxnId, blocked_until, participants) -> None:
-        self.engine.track(blocked_by, participants, Status.NOT_DEFINED)
+        # a waiter does not know the blocked dep's home shard: home=None
+        # keeps an already-tracked entry's classification, and awaited=True
+        # chases it at full cadence (reference BlockedUntil)
+        self.engine.track(blocked_by, participants, Status.NOT_DEFINED,
+                          home=None, awaited=True)
 
     def clear(self, txn_id: TxnId) -> None:
         self.engine.clear(txn_id)

@@ -426,12 +426,15 @@ class CheckStatus(Request):
                 # _init_waiting_on resurrects dropped deps): the truncation
                 # horizon, not the record, is the truth for below-floor ids
                 if store.is_truncated(self.txn_id, self.participants):
-                    # truncation only happens behind the durability floor:
-                    # the outcome is universally durable by construction
+                    # truncation only happens behind the durability floor,
+                    # but the erase floor only PROVES a majority-durable
+                    # sync point witnessed the outcome (applied durably or
+                    # invalidated) -- claiming UNIVERSAL here would mislead
+                    # a future consumer that trusts it (e.g. data erasure)
                     return CheckStatusOk(self.txn_id, Status.TRUNCATED,
                                          Ballot.ZERO, None, None, None, None,
                                          None, None,
-                                         durability=Durability.UNIVERSAL)
+                                         durability=Durability.MAJORITY)
             if cmd is None:
                 return CheckStatusOk(self.txn_id, Status.NOT_DEFINED,
                                      Ballot.ZERO, None, None, None, None,

@@ -25,6 +25,7 @@ blocking read happens `device_latency_ms` of simulated time later).
 """
 from __future__ import annotations
 
+from collections import deque
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -65,10 +66,16 @@ class ExecPlane:
                         # whose row indices refer to the old mapping
         self._compacting = False
         self._released: set = set()   # rows released (guard double release)
+        # in-order queue of in-flight frontier readbacks: [frontier,
+        # host copy or None, gen]; each dispatch schedules one harvest,
+        # which pops the head (mirrors ops/resolver.py's pipeline)
+        self._inflight: deque = deque()
+        self._poll_armed = False
         # bench/diagnostic counters
         self.dispatches = 0
         self.releases = 0
         self.harvest_stall_s = 0.0
+        self.prefetched = 0
 
     # -- row management ------------------------------------------------------
     def _row(self, txn_id: TxnId) -> int:
@@ -308,9 +315,38 @@ class ExecPlane:
             # next on_* hook re-arms the tick
             return
         frontier = self._dispatch()
-        gen = self._gen
-        self.store.node.scheduler.once(
-            self.device_latency_ms, lambda: self._harvest(frontier, gen))
+        self._inflight.append([frontier, None, self._gen])
+        self.store.node.scheduler.once(self.device_latency_ms, self._harvest)
+        self._ensure_poll()
+
+    def _ensure_poll(self) -> None:
+        """Between dispatch and harvest, a cheap deterministic poll drains
+        finished async readbacks via the non-blocking is_ready() probe; it
+        only fills the in-flight entries' host-copy slot (invisible to
+        simulated state), so determinism is untouched -- see
+        sim/scheduler.py poll()."""
+        scheduler = self.store.node.scheduler
+        poll = getattr(scheduler, "poll", None)
+        # opt-in via node.device_poll_ms, as in resolver._ensure_poll
+        interval = getattr(self.store.node, "device_poll_ms", None)
+        if poll is None or interval is None or self._poll_armed:
+            return
+        self._poll_armed = True
+        q = self._inflight
+
+        def prefetch() -> bool:
+            for entry in q:
+                if entry[1] is not None:
+                    continue
+                if not entry[0].is_ready():
+                    break  # single device stream: later calls finish later
+                entry[1] = np.asarray(entry[0])
+            if q:
+                return True
+            self._poll_armed = False
+            return False
+
+        poll(interval, prefetch)
 
     def _dispatch(self):
         import jax.numpy as jnp
@@ -342,12 +378,18 @@ class ExecPlane:
         self.dispatches += 1
         return out
 
-    def _harvest(self, frontier, gen: int) -> None:
+    def _harvest(self) -> None:
         import time as _time
         from accord_tpu.local import commands as _commands
-        t0 = _time.perf_counter()
-        packed = np.asarray(frontier)
-        self.harvest_stall_s += _time.perf_counter() - t0
+        if not self._inflight:
+            return  # defensive: every dispatch schedules exactly one harvest
+        frontier, packed, gen = self._inflight.popleft()
+        if packed is None:
+            t0 = _time.perf_counter()
+            packed = np.asarray(frontier)
+            self.harvest_stall_s += _time.perf_counter() - t0
+        else:
+            self.prefetched += 1
         if gen != self._gen:
             # compaction remapped rows while this frontier was in flight;
             # its indices address the old arena -- drop it (the rebuild

@@ -296,3 +296,20 @@ def bucket_size(n: int, minimum: int = 8) -> int:
     while size < n:
         size *= 2
     return size
+
+
+# The deps-resolver subject-batch padding ladder. Deliberately few named
+# tiers so the jit cache stays tiny and warmup() can cover every shape the
+# async pipeline dispatches: {8, 64, 128} handle the common batch-window
+# coalescing sizes (128 is the default MAX_DISPATCH), and anything larger
+# falls onto power-of-two buckets from 256 up (the bench's deep-dispatch
+# configurations warm their own tier explicitly).
+SUBJECT_TIERS = (8, 64, 128)
+
+
+def subject_tier(n: int) -> int:
+    """Padded subject-batch size for a dispatch of n subject chunks."""
+    for tier in SUBJECT_TIERS:
+        if n <= tier:
+            return tier
+    return bucket_size(n, 256)

@@ -22,19 +22,26 @@ Why the shape of this design (measured on the target TPU-via-tunnel setup):
     than a boolean matrix and independent of how many deps each subject
     has).
 
-Async protocol (deterministic): a node tick drains every store's queued
-PreAccepts/deps queries, runs the host-side preaccept transitions (witness
-timestamps come from the O(1) host MaxConflicts map), dispatches ONE kernel
-call for the whole batch (enqueue + copy_to_host_async -- no blocking), and
-schedules a HARVEST event `device_latency_ms` of *simulated* time later. The
-harvest consumes the transfer (blocking real time only if the pipeline is
-shallower than the tunnel latency), recovers exact per-key deps by
-intersecting real key sets (bucket collisions filtered), and completes the
-replies. Because dispatch and harvest points are pure functions of simulated
-state, runs remain bit-for-bit deterministic.
+Async protocol (deterministic, overlapped): a node tick drains every store's
+queued PreAccepts/deps queries, runs the host-side preaccept transitions
+(witness timestamps come from the O(1) host MaxConflicts map), dispatches ONE
+kernel call per max_dispatch slice (enqueue + copy_to_host_async -- no
+blocking), and appends the call to the node's IN-ORDER in-flight queue. Three
+stages then overlap in real time: host-encode of call N+1 (the next tick),
+device-execute of call N, and host-decode of call N-1 (its harvest event).
+Between dispatch and harvest a cheap deterministic POLL (sim/scheduler.py
+poll()) prefetches transfers the device has already finished via the
+non-blocking `is_ready()` probe, so the harvest's blocking read is the
+exception (pipeline shallower than the link latency), not the rule. Harvest
+events still fire at the deterministic `device_latency_ms` offset and polls
+mutate only host-side caches invisible to simulated state, so runs remain
+bit-for-bit deterministic. Compaction while calls are in flight pins the
+retiring row->txn snapshot; the harvest translates its packed rows to the
+new mapping instead of falling back to the host scan.
 """
 from __future__ import annotations
 
+from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -77,7 +84,7 @@ class HostDepsResolver(DepsResolver):
 
 
 def warmup(num_buckets: int = 1024, cap: int = 8192,
-           batch_tiers=(8, 64), scatter_tiers=(8, 64)) -> None:
+           batch_tiers=(8, 64, 128), scatter_tiers=(8, 64)) -> None:
     """Pre-compile the jit shape tiers the async pipeline uses (first
     compilation costs seconds on a tunnelled TPU; production would do the
     same at process start). The jit cache is process-global, so one call
@@ -159,9 +166,17 @@ class _NodeArena:
         self.had_truncation = False
         self._dirty_rows: set = set()
         self._device = None
-        # bumped by compact(): retires in-flight async calls whose packed
-        # rows address the old row mapping (they fall back to the host scan)
+        # bumped by compact(): in-flight async calls hold packed rows in the
+        # OLD row mapping. Dispatch pins the generation it encoded against;
+        # compact() then snapshots the retiring row->txn table so the harvest
+        # can TRANSLATE its rows onto the new mapping (no host fallback)
         self.gen = 0
+        self.retired_ids: Dict[int, np.ndarray] = {}
+        self._gen_pins: Dict[int, int] = {}
+        # (gen, count) -> (rank, order) cache for the global ts lexorder --
+        # ts[row] is written once at row creation, so it only invalidates on
+        # compaction (gen) or growth of the live prefix (count)
+        self._rank = None
 
     # -- host-side mutation ---------------------------------------------------
     def _ensure_encoder(self, ts: Timestamp) -> None:
@@ -194,11 +209,15 @@ class _NodeArena:
         /truncated rows (empty key_sets) are settled history no scan can
         match. Returns False when that would reclaim less than half the
         capacity (caller grows instead). Bumps `gen`: in-flight async calls
-        hold packed rows in the OLD mapping and fall back to the host scan
-        at harvest."""
+        hold packed rows in the OLD mapping; their harvests translate those
+        rows through the snapshot pinned below (no host fallback)."""
         live = [i for i in range(self.count) if self.key_sets[i]]
         if len(live) > self.cap // 2:
             return False
+        if self._gen_pins.get(self.gen):
+            # calls encoded against this mapping are still in flight: keep
+            # the row->txn table alive so their harvests can translate
+            self.retired_ids[self.gen] = self.ids_np[:self.count].copy()
         old_ids = self.txn_ids
         old_keys = self.key_sets
         old_exec = self.exec_max
@@ -247,6 +266,53 @@ class _NodeArena:
         self.gen += 1
         return True
 
+    # -- in-flight generation pinning -----------------------------------------
+    def pin_gen(self) -> int:
+        """An async call just encoded against the current row mapping: keep
+        its row->txn snapshot reachable across compaction until it drains."""
+        self._gen_pins[self.gen] = self._gen_pins.get(self.gen, 0) + 1
+        return self.gen
+
+    def unpin_gen(self, gen: int) -> None:
+        left = self._gen_pins.get(gen, 0) - 1
+        if left > 0:
+            self._gen_pins[gen] = left
+        else:
+            self._gen_pins.pop(gen, None)
+            if gen != self.gen:
+                self.retired_ids.pop(gen, None)
+
+    def translate_rows(self, gen: int, rows: np.ndarray) -> Optional[np.ndarray]:
+        """Map dep rows addressed in a RETIRED generation's packed result
+        onto the current mapping via txn ids. Exact: compaction only drops
+        rows whose key sets emptied (pruned/truncated history), and those
+        could no longer pass the exact key-membership filter anyway. None
+        when no snapshot was pinned (the caller falls back to the host)."""
+        ids = self.retired_ids.get(gen)
+        if ids is None:
+            return None
+        rows = rows[rows < ids.size]
+        out = np.fromiter((self.row_of.get(t, -1) for t in ids[rows]),
+                          np.int64, rows.size)
+        return out[out >= 0]
+
+    def row_rank(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Global ts-lane lexorder over rows [0, count): rank[row] = position
+        of the row in TxnId order, order = the inverse permutation. The lane
+        encoding is order-preserving, so rank order == TxnId order -- the
+        batched decode sorts dep rows once with it instead of lexsorting
+        per item."""
+        key = (self.gen, self.count)
+        cached = self._rank
+        if cached is not None and cached[0] == key:
+            return cached[1], cached[2]
+        ts = self.ts[:self.count]
+        order = np.lexsort((ts[:, 2], ts[:, 1], ts[:, 0]))
+        rank = np.empty(self.count, np.int64)
+        rank[order] = np.arange(self.count)
+        self._rank = (key, rank, order)
+        return rank, order
+
     def update(self, txn_id: TxnId, key_set, status: CfkStatus,
                conflict_ts: Timestamp) -> None:
         key_set = frozenset(key_set)
@@ -268,7 +334,7 @@ class _NodeArena:
             self.key_sets.append(frozenset(key_set))
             self.exec_max.append(None)
             self.row_of[txn_id] = row
-            self.ts[row] = self.encoder.encode([txn_id])[0]
+            self.ts[row] = self.encoder.encode_one(txn_id)
             self.kinds[row] = int(txn_id.kind)
             self.valid[row] = True
             self._set_row_keys(row)
@@ -287,7 +353,7 @@ class _NodeArena:
         prev = self.exec_max[row]
         if prev is None or conflict_ts > prev:
             self.exec_max[row] = conflict_ts
-            self.exec_ts[row] = self.encoder.encode([conflict_ts])[0]
+            self.exec_ts[row] = self.encoder.encode_one(conflict_ts)
         if status == CfkStatus.INVALIDATED:
             # drops the row from deps scans (a dep that never applies);
             # never reset -- invalidation is terminal
@@ -455,17 +521,6 @@ class _NodeArena:
         return self._device
 
 
-def _subject_tier(n: int) -> int:
-    """Subject-batch padding tiers -- deliberately few ({8, 64}, then pow2)
-    so the jit cache stays tiny and warmup() can cover it."""
-    if n <= 8:
-        return 8
-    if n <= 64:
-        return 64
-    from accord_tpu.ops.kernels import bucket_size
-    return bucket_size(n, 128)
-
-
 class _Item:
     """One queued resolution (a PreAccept's deps or a standalone deps query)."""
 
@@ -487,17 +542,20 @@ class _Item:
 
 
 class _Call:
-    __slots__ = ("packed", "items", "arena", "gen")
+    __slots__ = ("packed", "items", "arena", "gen", "np_packed")
 
     def __init__(self, packed, items, arena):
         self.packed = packed
         self.items = items
         self.arena = arena
         self.gen = arena.gen
+        # host copy of `packed`, filled by the poll prefetch once the device
+        # finishes (or by a blocking read at harvest when it hasn't)
+        self.np_packed: Optional[np.ndarray] = None
 
 
 class BatchDepsResolver(DepsResolver):
-    MAX_DISPATCH = 64   # subjects per kernel call (keeps jit tiers bounded)
+    MAX_DISPATCH = 128  # subjects per kernel call (a named, warmable jit tier)
 
     def __init__(self, num_buckets: int = 256, initial_cap: int = 4096,
                  max_dispatch: Optional[int] = None):
@@ -514,11 +572,19 @@ class BatchDepsResolver(DepsResolver):
         self._pa_queues: Dict[int, list] = {}
         self._deps_queues: Dict[int, list] = {}
         self._ticking: set = set()
+        # per-node IN-ORDER queue of in-flight calls; each dispatch schedules
+        # exactly one harvest event, which pops the head
+        self._inflight: Dict[int, "deque[_Call]"] = {}
+        self._polling: set = set()
         # bench counters
         self.dispatches = 0
         self.subjects = 0
+        self.encode_s = 0.0          # host-side upload-array build + enqueue
         self.harvest_stall_s = 0.0   # blocking on the async transfer
         self.decode_s = 0.0          # host-side result materialization
+        self.prefetched = 0          # harvests whose transfer the poll drained
+        self.stale_harvests = 0      # calls translated across a compaction
+        self.host_fallbacks = 0      # stale calls with no pinned snapshot
 
     # -- arena plumbing -------------------------------------------------------
     def _arena(self, store) -> _NodeArena:
@@ -616,30 +682,47 @@ class BatchDepsResolver(DepsResolver):
     def _encode_and_run(self, arena: _NodeArena, items: List[_Item]):
         """Chunk subjects, build the compact upload arrays, run the fused
         kernel. Shared by the async dispatch and the sync path -- the two
-        must never drift. Returns the (device) packed result array."""
+        must never drift. Returns the (device) packed result array.
+
+        Fully vectorized: one flat key gather, one modular reduction and one
+        fancy-index scatter build every subject row (how an item's keys split
+        across its MAXK-wide chunks is semantically arbitrary -- the chunks
+        are OR-ed back together at decode, and the device one-hot tolerates
+        duplicate bucket indices -- so no per-chunk sort/dedup is needed)."""
         import jax.numpy as jnp
-        from accord_tpu.ops.kernels import deps_resolve, pad_to
-        subj_keys: List[List[int]] = []
-        subj_before: List[Timestamp] = []
-        subj_kinds: List[int] = []
-        for item in items:
+        from accord_tpu.ops.kernels import subject_tier
+        MAXK = _NodeArena.MAXK
+        n = len(items)
+        counts = np.empty(n, np.int64)
+        for i, item in enumerate(items):
             item.cover_seq = item.store.cover_seq
-            ks = sorted(int(k) for k in item.owned)
-            for lo in range(0, max(len(ks), 1), _NodeArena.MAXK):
-                chunk = ks[lo:lo + _NodeArena.MAXK]
-                item.chunks.append(len(subj_keys))
-                subj_keys.append(chunk)
-                subj_before.append(item.before)
-                subj_kinds.append(int(item.txn_id.kind))
-        padded = _subject_tier(len(subj_keys))
-        sk = np.full((padded, _NodeArena.MAXK), -1, dtype=np.int32)
-        for i, chunk in enumerate(subj_keys):
-            mods = sorted({k % self.num_buckets for k in chunk})
-            sk[i, :len(mods)] = mods
-        return self._run_kernel(
-            arena, jnp.asarray(sk),
-            jnp.asarray(pad_to(arena.encoder.encode(subj_before), padded)),
-            jnp.asarray(pad_to(np.asarray(subj_kinds, np.int32), padded)))
+            counts[i] = len(item.owned)
+        total = int(counts.sum())
+        nchunks = np.maximum(-(-counts // MAXK), 1)
+        chunk_base = np.concatenate(([0], np.cumsum(nchunks)))
+        total_chunks = int(chunk_base[-1])
+        for i, item in enumerate(items):
+            item.chunks = list(range(chunk_base[i], chunk_base[i + 1]))
+        padded = subject_tier(total_chunks)
+        sk = np.full((padded, MAXK), -1, dtype=np.int32)
+        if total:
+            mods = (np.fromiter(
+                (int(k) for item in items for k in item.owned),
+                np.int64, total) % self.num_buckets).astype(np.int32)
+            item_of_key = np.repeat(np.arange(n), counts)
+            pos = np.arange(total) - np.repeat(np.cumsum(counts) - counts,
+                                               counts)
+            sk[chunk_base[item_of_key] + pos // MAXK, pos % MAXK] = mods
+        sb = np.zeros((padded, 3), dtype=np.int32)
+        sb[:total_chunks] = np.repeat(
+            arena.encoder.encode_many([item.before for item in items]),
+            nchunks, axis=0)
+        sknd = np.zeros(padded, dtype=np.int32)
+        sknd[:total_chunks] = np.repeat(
+            np.fromiter((int(item.txn_id.kind) for item in items),
+                        np.int64, n), nchunks)
+        return self._run_kernel(arena, jnp.asarray(sk), jnp.asarray(sb),
+                                jnp.asarray(sknd))
 
     def _run_kernel(self, arena: "_NodeArena", sk, sb, sknd):
         """The fused kernel call; ShardedBatchDepsResolver overrides this to
@@ -649,37 +732,30 @@ class BatchDepsResolver(DepsResolver):
         return deps_resolve(sk, sb, sknd,
                             act_bm, act_ts, act_kinds, act_valid, self._table)
 
-    def _decode_item(self, arena: _NodeArena, item: _Item, packed,
-                     bits=None) -> Deps:
-        """Recover one subject's exact key-domain deps from the bit-packed
-        kernel result. Shared by harvest and the sync path. `bits` is the
-        dispatch-wide pre-unpacked bool matrix when the caller batched the
-        unpack (the harvest path)."""
-        from accord_tpu.primitives.deps import KeyDeps
-        if packed is None:
-            kd = KeyDeps.EMPTY
-        elif bits is not None:
-            brow = bits[item.chunks[0]]
-            for c in item.chunks[1:]:
-                brow = brow | bits[c]
-            kd = arena.decode_rows(item.txn_id, sorted(item.owned),
-                                   np.nonzero(brow)[0].astype(np.int64),
-                                   item.store, item.before, item.cover_seq)
-        else:
-            prow = packed[item.chunks[0]]
-            for c in item.chunks[1:]:
-                prow = prow | packed[c]
-            kd = arena.decode_packed(item.txn_id, sorted(item.owned), prow,
-                                     item.store, item.before, item.cover_seq)
+    def _host_only_prep(self, arena: _NodeArena):
+        """Precompute the host_only residual scan's inputs once per harvest:
+        (live wide rows, union of their keys) -- or None, letting every item
+        skip the supplement with one set lookup."""
         if not arena.host_only:
-            return Deps(kd)
-        # rows too wide for the device (> MAXK keys) are scanned host-side
-        kb = KeyDepsBuilder()
+            return None
+        rows = [j for j in arena.host_only if j not in arena.invalidated]
+        if not rows:
+            return None
+        keys: set = set()
+        for j in rows:
+            keys |= arena.key_sets[j]
+        return rows, keys
+
+    def _host_only_residual(self, arena: _NodeArena, item: _Item, kd, ho):
+        """Rows too wide for the device (> MAXK keys) are scanned host-side
+        and unioned into the device result (rare)."""
+        rows, ho_keys = ho
         subj_set = set(item.owned)
+        if ho_keys.isdisjoint(subj_set):
+            return kd
+        kb = KeyDepsBuilder()
         cfks = item.store.cfks
-        for j in arena.host_only:
-            if j in arena.invalidated:
-                continue  # host scan excludes invalidated deps too
+        for j in rows:
             dep_id = arena.txn_ids[j]
             if dep_id != item.txn_id and dep_id < item.before \
                     and item.txn_id.kind.witnesses(dep_id.kind):
@@ -690,57 +766,283 @@ class BatchDepsResolver(DepsResolver):
                             and e[1] < item.before:
                         continue  # transitive-dependency elision (cfk rule)
                     kb.add(k, dep_id)
-        return Deps(kd.union(kb.build()))
+        return kd.union(kb.build())
+
+    def _decode_batch(self, arena: _NodeArena, items: List[_Item],
+                      packed: np.ndarray) -> list:
+        """Recover every item's exact key-domain deps from the dispatch-wide
+        bit-packed kernel result in one vectorized pass -> [KeyDeps].
+
+        Replaces the per-item decode loop (whose per-subject numpy-call
+        overhead dominated harvest at large dispatch sizes): one reduceat
+        OR-combines each item's chunks, one unpackbits yields all candidate
+        (item, dep row) pairs, a stacked key-bitmask gather tests exact key
+        membership for every (candidate, key slot) pair at once, and a single
+        global sort by (key slot, timestamp rank) puts every item's CSR in
+        final order. Per-item work is reduced to slicing its segment."""
+        from accord_tpu.primitives.deps import KeyDeps
+        n = len(items)
+        out = [KeyDeps.EMPTY] * n
+        # 1. OR each item's chunk rows together (chunks are consecutive)
+        starts = np.fromiter((item.chunks[0] for item in items), np.int64, n)
+        end = items[-1].chunks[-1] + 1
+        item_packed = np.bitwise_or.reduceat(
+            np.ascontiguousarray(packed[:end]).astype("<u4", copy=False),
+            starts, axis=0)
+        # 2. clear each subject's own row bit (self is never a dep)
+        srows = np.fromiter((arena.row_of.get(item.txn_id, -1)
+                             for item in items), np.int64, n)
+        has_self = np.nonzero(srows >= 0)[0]
+        if has_self.size:
+            r = srows[has_self]
+            item_packed[has_self, r >> 5] &= \
+                ~(np.uint32(1) << (r & 31).astype(np.uint32))
+        if not item_packed.any():
+            return out
+        # 3. all candidate (item, dep row) pairs in one unpack
+        ibits = np.unpackbits(item_packed.view(np.uint8),
+                              bitorder="little", axis=1)
+        cand_item, cand_row = np.nonzero(ibits)
+        # 4. flatten each item's key slots; dedupe identical key-bitmask
+        #    arrays so the stacked gather matrix stays small
+        masks: List[np.ndarray] = []
+        mask_idx: Dict[int, int] = {}
+        flat_maskrow: List[int] = []
+        flat_key: List[object] = []
+        flat_cov: List[Optional[dict]] = []
+        key_cnt = np.zeros(n, np.int64)
+        covered_any = False
+        for i, item in enumerate(items):
+            cfks = item.store.cfks
+            cnt = 0
+            for k in item.owned:    # Keys iterates sorted unique
+                kr = arena.key_rows.get(k)
+                if kr is None:
+                    continue
+                mi = mask_idx.get(id(kr))
+                if mi is None:
+                    mi = mask_idx[id(kr)] = len(masks)
+                    masks.append(kr)
+                flat_maskrow.append(mi)
+                flat_key.append(k)
+                c = cfks.get(k)
+                cov = c.covered if c is not None and c.covered else None
+                flat_cov.append(cov)
+                covered_any = covered_any or cov is not None
+                cnt += 1
+            key_cnt[i] = cnt
+        if not masks or cand_item.size == 0:
+            return out
+        key_off = np.concatenate(([0], np.cumsum(key_cnt)))
+        slot_item = np.repeat(np.arange(n), key_cnt)
+        KM = np.stack(masks)
+        maskrow = np.asarray(flat_maskrow, np.int64)
+        # 5. expand candidates over their item's key slots, test membership
+        #    with packed-bit gathers (exactness: key_rows tracks REAL key
+        #    sets, so bucket collisions and cross-store rows drop out here)
+        rep = key_cnt[cand_item]
+        e_cand = np.repeat(np.arange(cand_item.size), rep)
+        if e_cand.size == 0:
+            return out
+        cum = np.cumsum(rep)
+        pos = np.arange(e_cand.size) - np.repeat(cum - rep, rep)
+        slot = key_off[cand_item[e_cand]] + pos
+        e_row = cand_row[e_cand].astype(np.int64)
+        hit = ((KM[maskrow[slot], e_row >> 5]
+                >> (e_row & 31).astype(np.uint32)) & 1).astype(bool)
+        h_slot = slot[hit]
+        h_row = e_row[hit]
+        if h_slot.size == 0:
+            return out
+        # 6. one global sort: flat slots increase per (item, key), so
+        #    (slot, rank) order groups by item, then key, then TxnId order
+        rank, order = arena.row_rank()
+        o = np.lexsort((rank[h_row], h_slot))
+        h_slot = h_slot[o]
+        h_row = h_row[o]
+        # 7. transitive-dependency elision, only over slots with covers
+        if covered_any:
+            seg = np.flatnonzero(np.r_[True, h_slot[1:] != h_slot[:-1]])
+            seg_end = np.r_[seg[1:], h_slot.size]
+            keep = np.ones(h_slot.size, bool)
+            ids = arena.ids_np
+            for a, b in zip(seg, seg_end):
+                cov = flat_cov[h_slot[a]]
+                if cov is None:
+                    continue
+                item = items[slot_item[h_slot[a]]]
+                cs, bf = item.cover_seq, item.before
+                for t in range(a, b):
+                    e = cov.get(ids[h_row[t]])
+                    # elide only covers the kernel snapshot already saw
+                    # (seq <= cover_seq) whose cover executes below the
+                    # subject's bound -- the host scan's exact rule plus
+                    # the snapshot guard
+                    if e is not None and e[0] <= cs and e[1] < bf:
+                        keep[t] = False
+            if not keep.all():
+                h_slot = h_slot[keep]
+                h_row = h_row[keep]
+        if h_slot.size == 0:
+            return out
+        # 8. per-item CSR assembly from its slice of the sorted arrays
+        h_rank = rank[h_row]
+        bounds = np.searchsorted(h_slot, key_off)
+        for i in range(n):
+            a, b = int(bounds[i]), int(bounds[i + 1])
+            if a == b:
+                continue
+            seg_slot = h_slot[a:b]
+            uniq, inv = np.unique(h_rank[a:b], return_inverse=True)
+            txn_ids = tuple(arena.ids_np[order[uniq]].tolist())
+            kb = np.flatnonzero(np.r_[True, seg_slot[1:] != seg_slot[:-1]])
+            keys_present = tuple(flat_key[seg_slot[j]] for j in kb)
+            offsets = tuple(kb.tolist()) + (b - a,)
+            out[i] = KeyDeps(keys_present, txn_ids, offsets,
+                             tuple(inv.tolist()))
+        return out
+
+    def _decode_dispatch(self, call: _Call) -> List[Deps]:
+        """Decode a harvested call against the (matching-generation) arena:
+        batched device decode + host_only residual + range union + floor."""
+        from accord_tpu.primitives.deps import KeyDeps
+        arena = call.arena
+        if call.np_packed is None:
+            kds = [KeyDeps.EMPTY] * len(call.items)
+        else:
+            kds = self._decode_batch(arena, call.items, call.np_packed)
+        ho = self._host_only_prep(arena)
+        results = []
+        for item, kd in zip(call.items, kds):
+            store = item.store
+            if ho is not None:
+                kd = self._host_only_residual(arena, item, kd, ho)
+            deps = Deps(kd)
+            if store.range_txns:
+                deps = deps.union(store.host_range_deps(
+                    item.txn_id, item.owned, item.before))
+            results.append(store.inject_dep_floor(item.txn_id, item.owned,
+                                                  deps, item.before))
+        return results
+
+    def _decode_stale(self, call: _Call) -> List[Deps]:
+        """The arena compacted while this call was in flight: its packed
+        rows address the RETIRED row mapping. Translate them (old row -> txn
+        id -> current row, via the snapshot compact() pinned) and decode
+        against current state -- identical semantics to the normal path,
+        which also decodes against post-dispatch state. Falls back to the
+        host scan only if no snapshot exists (counted; not expected)."""
+        arena = call.arena
+        packed = call.np_packed
+        ho = self._host_only_prep(arena)
+        results = []
+        for item in call.items:
+            store = item.store
+            rows = None
+            if packed is not None:
+                prow = packed[item.chunks[0]]
+                for c in item.chunks[1:]:
+                    prow = prow | packed[c]
+                wnz = np.nonzero(prow)[0]
+                sub = np.unpackbits(prow[wnz].astype("<u4").view(np.uint8),
+                                    bitorder="little").reshape(wnz.size, 32)
+                rr, cc = np.nonzero(sub)
+                old_rows = (wnz[rr].astype(np.int64) << 5) | cc
+                rows = arena.translate_rows(call.gen, old_rows)
+            if rows is None:
+                self.host_fallbacks += 1
+                raw = store.host_calculate_deps(item.txn_id, item.owned,
+                                                item.before)
+                results.append(store.inject_dep_floor(
+                    item.txn_id, item.owned, raw, item.before))
+                continue
+            kd = arena.decode_rows(item.txn_id, item.owned, rows,
+                                   store, item.before, item.cover_seq)
+            if ho is not None:
+                kd = self._host_only_residual(arena, item, kd, ho)
+            deps = Deps(kd)
+            if store.range_txns:
+                deps = deps.union(store.host_range_deps(
+                    item.txn_id, item.owned, item.before))
+            results.append(store.inject_dep_floor(item.txn_id, item.owned,
+                                                  deps, item.before))
+        return results
 
     def _dispatch(self, node, items: List[_Item]) -> None:
+        import time as _time
         for item in items:
             self._arena(item.store)  # ensure adoption of late-attached stores
         arena = self._arenas.get(id(node))
         if arena is None or arena.count == 0:
             call = _Call(None, items, arena or _NodeArena(self.num_buckets, 8))
         else:
+            t0 = _time.perf_counter()
             packed = self._encode_and_run(arena, items)
             packed.copy_to_host_async()
+            self.encode_s += _time.perf_counter() - t0
             call = _Call(packed, items, arena)
+            arena.pin_gen()  # matched by unpin_gen in _harvest
         self.dispatches += 1
         self.subjects += len(items)
+        self._inflight.setdefault(id(node), deque()).append(call)
         delay = getattr(node, "device_latency_ms", 4.0)
-        node.scheduler.once(delay, lambda: self._harvest(call))
+        node.scheduler.once(delay, lambda: self._harvest(node))
+        self._ensure_poll(node)
 
-    def _harvest(self, call: _Call) -> None:
+    def _ensure_poll(self, node) -> None:
+        """Arm the per-node readiness poll (if the scheduler supports it):
+        between dispatch and harvest it drains finished async transfers via
+        the non-blocking is_ready() probe, so by the time the deterministic
+        harvest event fires the host copy is usually already here. The poll
+        only fills _Call.np_packed -- a host-side cache invisible to
+        simulated state -- so burns stay bit-for-bit deterministic."""
+        poll = getattr(node.scheduler, "poll", None)
+        # opt-in via node.device_poll_ms (the bench and real-device deploys
+        # set it): poll events are invisible to protocol state but do consume
+        # event-queue sequence numbers, so burns that pin exact histories
+        # keep their seed-for-seed schedules by defaulting it off
+        interval = getattr(node, "device_poll_ms", None)
+        if poll is None or interval is None or id(node) in self._polling:
+            return
+        self._polling.add(id(node))
+        q = self._inflight[id(node)]
+
+        def prefetch() -> bool:
+            for call in q:
+                if call.packed is None or call.np_packed is not None:
+                    continue
+                if not call.packed.is_ready():
+                    break  # single device stream: later calls finish later
+                call.np_packed = np.asarray(call.packed)
+            if q:
+                return True
+            self._polling.discard(id(node))
+            return False
+
+        poll(interval, prefetch)
+
+    def _harvest(self, node) -> None:
         import time as _time
-        stale = call.gen != call.arena.gen
-        packed = None
-        bits = None
-        if call.packed is not None and not stale:
-            t0 = _time.perf_counter()
-            packed = np.asarray(call.packed)
-            self.harvest_stall_s += _time.perf_counter() - t0
+        q = self._inflight.get(id(node))
+        if not q:
+            return  # defensive: every dispatch schedules exactly one harvest
+        call = q.popleft()
+        arena = call.arena
+        if call.packed is not None:
+            if call.np_packed is not None:
+                self.prefetched += 1
+            else:
+                t0 = _time.perf_counter()
+                call.np_packed = np.asarray(call.packed)
+                self.harvest_stall_s += _time.perf_counter() - t0
         t0 = _time.perf_counter()
-        if packed is not None:
-            # one dispatch-wide unpack: per-subject numpy-call overhead is
-            # what dominates the decode at large dispatch sizes
-            bits = np.unpackbits(
-                np.ascontiguousarray(packed).astype("<u4", copy=False)
-                .view(np.uint8), bitorder="little", axis=1)
-        results = []
-        for item in call.items:
-            store = item.store
-            if stale:
-                # the arena compacted while this call was in flight: its
-                # packed rows address the OLD row mapping -- answer from the
-                # host scan (rare; exact, floor-injected like the normal path)
-                raw = store.host_calculate_deps(item.txn_id, item.owned,
-                                                item.before)
-                results.append(store.inject_dep_floor(
-                    item.txn_id, item.owned, raw, item.before))
-                continue
-            deps = self._decode_item(call.arena, item, packed, bits)
-            if store.range_txns:
-                deps = deps.union(store.host_range_deps(
-                    item.txn_id, item.owned, item.before))
-            results.append(store.inject_dep_floor(item.txn_id, item.owned,
-                                                  deps, item.before))
+        if call.packed is not None and call.gen != arena.gen:
+            self.stale_harvests += 1
+            results = self._decode_stale(call)
+        else:
+            results = self._decode_dispatch(call)
+        if call.packed is not None:
+            arena.unpin_gen(call.gen)
         self.decode_s += _time.perf_counter() - t0
         for item, deps in zip(call.items, results):
             if item.outcome is not None:
@@ -777,7 +1079,12 @@ class BatchDepsResolver(DepsResolver):
         items = [_Item(store, t, owned, before, None)
                  for (t, owned, before) in subjects]
         packed = np.asarray(self._encode_and_run(arena, items))
-        return [self._decode_item(arena, item, packed) for item in items]
+        kds = self._decode_batch(arena, items, packed)
+        ho = self._host_only_prep(arena)
+        if ho is not None:
+            kds = [self._host_only_residual(arena, item, kd, ho)
+                   for item, kd in zip(items, kds)]
+        return [Deps(kd) for kd in kds]
 
     # -- max-conflict (device path; inline mode + bench only) ----------------
     def max_conflict(self, store, txn_id: TxnId,

@@ -178,6 +178,31 @@ def sharded_deps_resolve(mesh: Mesh):
         rep2), out_shardings=NamedSharding(mesh, P(None, "data")))
 
 
+def warmup_sharded(mesh: Mesh, num_buckets: int = 256, cap: int = 4096,
+                   batch_tiers: Tuple[int, ...] = (8, 64, 128)) -> None:
+    """Pre-compile the sharded hot kernel's subject-batch jit tiers (the
+    sharded twin of ops.resolver.warmup; same {8, 64, 128} padding ladder
+    the overlapped pipeline dispatches). One call covers every
+    ShardedBatchDepsResolver on the same mesh + (num_buckets, cap) --
+    sharded_deps_resolve is lru_cached by mesh and jit caches by shape."""
+    from accord_tpu.ops.encoding import WITNESS_TABLE
+    from accord_tpu.ops.resolver import _NodeArena
+    kern = sharded_deps_resolve(mesh)
+    maxk = _NodeArena.MAXK
+    bm = jnp.zeros((cap, num_buckets), jnp.float32)
+    ts = jnp.zeros((cap, 3), jnp.int32)
+    kinds = jnp.zeros(cap, jnp.int32)
+    valid = jnp.zeros(cap, bool)
+    table = jnp.asarray(WITNESS_TABLE)
+    out = None
+    for b in batch_tiers:
+        out = kern(jnp.full((b, maxk), -1, jnp.int32),
+                   jnp.zeros((b, 3), jnp.int32), jnp.zeros(b, jnp.int32),
+                   bm, ts, kinds, valid, table)
+    if out is not None:
+        jax.block_until_ready(out)
+
+
 def example_batch(n: int = 64, k: int = 256, seed: int = 0):
     """Deterministic example inputs for compile checks and dry runs."""
     rng = np.random.default_rng(seed)

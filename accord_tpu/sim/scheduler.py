@@ -34,6 +34,28 @@ class SimScheduler(Scheduler):
         # the event loop without further delay, and keeps causal ordering
         fn()
 
+    def poll(self, interval_ms: float, fn: Callable[[], bool]) -> Cancellable:
+        """Cheap deterministic poll: re-run `fn` every `interval_ms` of
+        simulated time until it returns False (or the handle is cancelled).
+
+        The device pipelines use this to prefetch completed async
+        device->host transfers between their dispatch and harvest events
+        WITHOUT blocking: `fn` may only mutate host-side caches that are
+        invisible to simulated state (the results are delivered at the
+        deterministic harvest event either way), so the poll cadence --
+        itself a pure function of simulated time -- never perturbs the
+        bit-for-bit determinism of a burn."""
+        handle = Cancellable()
+
+        def tick():
+            if handle.cancelled:
+                return
+            if fn():
+                self.queue.add(int(interval_ms * 1000), tick)
+
+        self.queue.add(int(interval_ms * 1000), tick)
+        return handle
+
 
 class NodeScheduler(SimScheduler):
     """Per-node facade with a kill switch: after a crash, the dead
@@ -66,6 +88,19 @@ class NodeScheduler(SimScheduler):
                 return  # dead: neither run nor RE-ARM
             fn()
             self.queue.add(int(interval_ms * 1000), tick)
+
+        self.queue.add(int(interval_ms * 1000), tick)
+        return handle
+
+    def poll(self, interval_ms: float, fn: Callable[[], bool]) -> Cancellable:
+        handle = Cancellable()
+        cell = self.alive
+
+        def tick():
+            if handle.cancelled or not cell[0]:
+                return  # dead: neither run nor RE-ARM
+            if fn():
+                self.queue.add(int(interval_ms * 1000), tick)
 
         self.queue.add(int(interval_ms * 1000), tick)
         return handle

@@ -130,20 +130,32 @@ def bench_pipeline(quick: bool):
             f"{check_n} subjects")
 
     # device leg, throughput: the REAL async pipeline (dispatch windows +
-    # deferred harvests overlapping the transfer), exactly as the protocol
-    # consumes it. The protocol thread only ever blocks on harvest stalls +
-    # result decode; the sustained rate is what 10k-concurrent coordination
-    # sees.
+    # deferred harvests overlapping the transfer + readiness polling),
+    # exactly as the protocol consumes it. The protocol thread only ever
+    # blocks on harvest stalls + result decode; the sustained rate is what
+    # 10k-concurrent coordination sees.
     store.batch_window_ms = 2.0
     node.device_latency_ms = 80.0
+    node.device_poll_ms = 1.0   # arm the prefetch poll (opt-in)
     stall0 = resolver.harvest_stall_s + resolver.decode_s
     done = [0]
+    failed = [0]
+
+    def completion(v, f):
+        # successes only: a failed resolution must not count as completed
+        if f is None:
+            done[0] += 1
+        else:
+            failed[0] += 1
+
     t0 = time.perf_counter()
     for txn_id, keys, before in subjects:
         resolver.enqueue_deps(store, txn_id, keys, before) \
-            .add_callback(lambda v, f: done.__setitem__(0, done[0] + 1))
+            .add_callback(completion)
     cluster.queue.drain(max_events=1_000_000)
     dev_wall = time.perf_counter() - t0
+    if failed[0]:
+        raise AssertionError(f"async pipeline failed {failed[0]} resolutions")
     if done[0] != subjects_n:
         raise AssertionError(f"async pipeline resolved {done[0]}/{subjects_n}")
     dev_block_us = (resolver.harvest_stall_s + resolver.decode_s - stall0) \
@@ -153,15 +165,26 @@ def bench_pipeline(quick: bool):
     host_mean = float(np.mean(host_samples)) * 1e6
 
     # -- large replay (BASELINE "YCSB-T-style large replay"): stream >=100k
-    # deps queries through the SAME loaded store, chunked the way sustained
-    # coordination arrives, recording per-subject wall latency percentiles.
+    # deps queries through the SAME loaded store with WINDOWED admission --
+    # up to `window` ops outstanding at all times, so host-encode of the
+    # next dispatch overlaps device-execute and host-decode of earlier ones
+    # (a full drain per chunk would empty the pipeline at every boundary).
     # The host comparison is its measured serial scan rate (a serial replay
     # of the same op count).
     replay_ops = 10_000 if quick else LARGE_REPLAY_OPS
-    chunk = 2 * PIPE_BATCH  # two in-flight dispatches per chunk
+    chunk = 2 * PIPE_BATCH
+    window = 2 * chunk      # >= 4 in-flight dispatches
     done = [0]
+    failed = [0]
+    enc0 = resolver.encode_s
+    stall0 = resolver.harvest_stall_s
+    dec0 = resolver.decode_s
+    pre0 = resolver.prefetched
+    stale0 = resolver.stale_harvests
+    fall0 = resolver.host_fallbacks
     chunk_walls = []
     chunk_sizes = []
+    enqueued = 0
     replay_t0 = time.perf_counter()
     for base in range(0, replay_ops, chunk):
         n = min(chunk, replay_ops - base)
@@ -173,12 +196,24 @@ def bench_pipeline(quick: bool):
                                   Domain.KEY)
             keys = store.owned(Keys(rng.next_int(PIPE_KEYS) for _ in range(4)))
             resolver.enqueue_deps(store, txn_id, keys, ts) \
-                .add_callback(lambda v, f: done.__setitem__(0, done[0] + 1))
-        cluster.queue.drain(max_events=1_000_000)
+                .add_callback(completion)
+            enqueued += 1
+            while enqueued - done[0] - failed[0] >= window \
+                    and cluster.queue.process_one():
+                pass
+        if base + n >= replay_ops:
+            # final drain folds into the last chunk's wall
+            cluster.queue.drain(max_events=2_000_000)
         chunk_walls.append(time.perf_counter() - c0)
     replay_wall = time.perf_counter() - replay_t0
+    if failed[0]:
+        raise AssertionError(f"large replay failed {failed[0]} resolutions")
     if done[0] != replay_ops:
         raise AssertionError(f"large replay resolved {done[0]}/{replay_ops}")
+    if resolver.host_fallbacks != fall0:
+        raise AssertionError(
+            f"large replay hit {resolver.host_fallbacks - fall0} stale-arena "
+            "host fallbacks (generation pinning should translate instead)")
     per_op = np.asarray(chunk_walls) / np.asarray(chunk_sizes) * 1e6
     host_projected_s = replay_ops * (host_mean / 1e6)
 
@@ -199,14 +234,22 @@ def bench_pipeline(quick: bool):
         "large_replay": {
             "ops": replay_ops,
             "chunk": chunk,
+            "window": window,
             "device_wall_s": round(replay_wall, 1),
             "device_throughput_per_s": round(replay_ops / max(replay_wall, 1e-9)),
-            # amortized per-op cost distribution over one-dispatch chunks
+            # amortized per-op cost distribution over admission chunks
             "per_op_us": {
                 "p50": round(float(np.percentile(per_op, 50)), 1),
                 "p99": round(float(np.percentile(per_op, 99)), 1),
                 "p999": round(float(np.percentile(per_op, 99.9)), 1),
             },
+            # pipeline-stage costs over the replay (deltas)
+            "encode_s": round(resolver.encode_s - enc0, 2),
+            "harvest_stall_s": round(resolver.harvest_stall_s - stall0, 2),
+            "decode_s": round(resolver.decode_s - dec0, 2),
+            "prefetched": resolver.prefetched - pre0,
+            "stale_harvests": resolver.stale_harvests - stale0,
+            "host_fallbacks": resolver.host_fallbacks - fall0,
             "host_serial_projected_s": round(host_projected_s, 1),
             "vs_host_serial": round(host_projected_s / max(replay_wall, 1e-9), 2),
         },
@@ -273,8 +316,12 @@ def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool):
         stats = {
             "dispatches": sum(r.dispatches for r in resolvers),
             "subjects": sum(r.subjects for r in resolvers),
+            "encode_s": round(sum(r.encode_s for r in resolvers), 2),
             "harvest_stall_s": round(sum(r.harvest_stall_s for r in resolvers), 2),
             "decode_s": round(sum(r.decode_s for r in resolvers), 2),
+            "prefetched": sum(r.prefetched for r in resolvers),
+            "stale_harvests": sum(r.stale_harvests for r in resolvers),
+            "host_fallbacks": sum(r.host_fallbacks for r in resolvers),
         }
     else:
         stats = {
@@ -426,7 +473,7 @@ def main(argv=None) -> int:
         warmup(num_buckets=E2E_BUCKETS, cap=E2E_ARENA_CAP,
                batch_tiers=(8, 64, 128, 256), scatter_tiers=(8, 64))
         warmup(num_buckets=PIPE_BUCKETS, cap=PIPE_CAP,
-               batch_tiers=(8, 64, PIPE_BATCH), scatter_tiers=(8, 64))
+               batch_tiers=(8, 64, 128, PIPE_BATCH), scatter_tiers=(8, 64))
         warm_s = time.perf_counter() - t0
 
         pipeline = bench_pipeline(args.quick)

@@ -1,0 +1,159 @@
+"""The overlapped device pipeline: multiple calls in flight at once, arena
+compaction racing them, and the readiness poll -- the paths the double-
+buffered dispatch added on top of the single-outstanding-call resolver.
+
+The compaction test is the load-bearing one: a call encoded against
+generation G must, after compact() bumps to G+1 mid-flight, still decode on
+the DEVICE path (row translation through the pinned snapshot), not fall back
+to the host scan -- and the translated result must equal the host scan
+exactly."""
+from __future__ import annotations
+
+import numpy as np
+
+from accord_tpu.ops.resolver import BatchDepsResolver
+from accord_tpu.primitives.keyspace import Keys
+from tests.test_local_engine import setup_store
+from tests.test_ops import _preaccept_population
+
+
+def _pipelined_store():
+    """A store wired for the async pipeline: resolver attached, a real batch
+    window, device latency long enough to stack dispatches, poll armed."""
+    cluster, node, store = setup_store()
+    resolver = BatchDepsResolver(num_buckets=128, initial_cap=128)
+    store.deps_resolver = resolver
+    store.batch_window_ms = 0.5
+    node.device_latency_ms = 50.0   # harvests land well after both ticks
+    node.device_poll_ms = 1.0       # exercise the readiness-poll prefetch
+    return cluster, node, store, resolver
+
+
+def test_compaction_with_two_calls_in_flight():
+    """Compact the arena while >= 2 calls are in flight; both harvests must
+    translate their retired-generation rows (no host fallback) and match the
+    host scan bit-for-bit."""
+    rng = np.random.default_rng(21)
+    cluster, node, store, resolver = _pipelined_store()
+    # chaff on a disjoint key range [100, 140): pruned from the arena below
+    # to make compaction reclaim >= half the capacity. Subjects only ever
+    # query keys < 12, so pruning these arena-side cannot perturb the
+    # host-vs-device differential.
+    chaff_keys = [sorted(set(rng.integers(100, 140, 2).tolist()))
+                  for _ in range(50)]
+    chaff = _preaccept_population(store, node, chaff_keys)
+    live_keys = [sorted(set(rng.integers(0, 12, 2).tolist()))
+                 for _ in range(40)]
+    live = _preaccept_population(store, node, live_keys)
+
+    arena = resolver._arenas[id(node)]
+    for t, ks in zip(chaff, chaff_keys):
+        resolver.on_prune(store, t, ks)
+
+    def enqueue(idxs):
+        outs = []
+        for i in idxs:
+            t = live[i]
+            keys = Keys(live_keys[i])
+            before = store.command(t).execute_at
+            outs.append((t, keys, before,
+                         resolver.enqueue_deps(store, t, keys, before)))
+        return outs
+
+    batch_a = enqueue(range(20, 26))
+    while resolver.dispatches < 1:
+        assert cluster.queue.process_one(), "tick never fired"
+    batch_b = enqueue(range(30, 36))
+    while resolver.dispatches < 2:
+        assert cluster.queue.process_one(), "second tick never fired"
+
+    # both calls in flight, poll armed, nothing harvested yet
+    assert len(resolver._inflight[id(node)]) == 2
+    assert id(node) in resolver._polling
+    assert all(not out.done for *_, out in batch_a + batch_b)
+
+    gen0 = arena.gen
+    assert arena.compact(), "compaction should reclaim the pruned chaff"
+    assert arena.gen == gen0 + 1
+    # the in-flight pins forced a row->txn snapshot of the retired mapping
+    assert gen0 in arena.retired_ids
+
+    while not all(out.done for *_, out in batch_a + batch_b):
+        assert cluster.queue.process_one(), "harvest never fired"
+
+    # both harvests crossed the compaction on the DEVICE path
+    assert resolver.stale_harvests == 2
+    assert resolver.host_fallbacks == 0
+    # drained: pins released, snapshot dropped, poll disarmed
+    cluster.queue.drain(max_events=10_000)
+    assert gen0 not in arena.retired_ids
+    assert id(node) not in resolver._polling
+
+    nonempty = 0
+    for t, keys, before, out in batch_a + batch_b:
+        host = store.host_calculate_deps(t, keys, before)
+        got = out.value()
+        assert got == host, f"subject {t}: {got} != {host}"
+        nonempty += bool(got.key_deps.all_txn_ids())
+    assert nonempty > 0, "differential vacuous: every subject had no deps"
+
+
+def test_harvest_order_and_reuse_after_compaction():
+    """After the stale harvests drain, the SAME resolver must keep answering
+    exactly on the new generation (fresh dispatch, no translation)."""
+    rng = np.random.default_rng(5)
+    cluster, node, store, resolver = _pipelined_store()
+    chaff_keys = [[100 + int(k)] for k in rng.integers(0, 30, 50)]
+    chaff = _preaccept_population(store, node, chaff_keys)
+    live_keys = [sorted(set(rng.integers(0, 8, 2).tolist()))
+                 for _ in range(30)]
+    live = _preaccept_population(store, node, live_keys)
+    arena = resolver._arenas[id(node)]
+    for t, ks in zip(chaff, chaff_keys):
+        resolver.on_prune(store, t, ks)
+
+    t0 = live[25]
+    out0 = resolver.enqueue_deps(store, t0, Keys(live_keys[25]),
+                                 store.command(t0).execute_at)
+    while resolver.dispatches < 1:
+        assert cluster.queue.process_one()
+    assert arena.compact()
+    cluster.queue.drain(max_events=10_000)
+    assert out0.done and resolver.stale_harvests == 1
+
+    # second wave on the compacted arena: normal (non-stale) decode
+    t1 = live[28]
+    before1 = store.command(t1).execute_at
+    out1 = resolver.enqueue_deps(store, t1, Keys(live_keys[28]), before1)
+    cluster.queue.drain(max_events=10_000)
+    assert out1.done
+    assert resolver.stale_harvests == 1  # unchanged
+    assert resolver.host_fallbacks == 0
+    host = store.host_calculate_deps(t1, Keys(live_keys[28]), before1)
+    assert out1.value() == host
+
+
+def test_pipeline_burn_deterministic():
+    """Two burns with the overlapped pipeline (batch window + readiness poll)
+    must produce bit-identical histories under the same seed: the poll only
+    fills host-side caches, never simulated state."""
+    from accord_tpu.sim.burn import run_burn
+    from accord_tpu.sim.cluster import ClusterConfig
+
+    class PollingResolver(BatchDepsResolver):
+        def _dispatch(self, node, items):
+            if getattr(node, "device_poll_ms", None) is None:
+                node.device_poll_ms = 1.0
+            super()._dispatch(node, items)
+
+    def cfg():
+        return ClusterConfig(
+            deps_resolver_factory=lambda: PollingResolver(num_buckets=128),
+            deps_batch_window_ms=1.0)
+
+    kw = dict(ops=60, key_count=8, concurrency=6, collect_log=True)
+    a = run_burn(17, config=cfg(), **kw)
+    b = run_burn(17, config=cfg(), **kw)
+    assert a.acked == b.acked == 60
+    assert a.lost == 0
+    assert a.log == b.log
