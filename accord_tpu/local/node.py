@@ -41,6 +41,7 @@ class Node:
                  progress_log_factory: Optional[Callable] = None,
                  deps_resolver=None, deps_batch_window_ms: Optional[float] = 0.0,
                  device_latency_ms: float = 4.0,
+                 device_poll_ms: Optional[float] = None,
                  events: Optional[EventsListener] = None):
         self.id = node_id
         # lightweight observability: protocol event counts (probes sent,
@@ -65,6 +66,11 @@ class Node:
         # models real accelerator latency AND gives the pipeline depth that
         # hides the host<->device round trip (see ops/resolver.py)
         self.device_latency_ms = device_latency_ms
+        # readiness-poll cadence for harvesting in-flight device calls early
+        # (resolver._ensure_poll / exec_plane): None disables polling -- the
+        # right default under the sim scheduler, where poll events would
+        # perturb sequence numbers; real-device deploys (maelstrom) enable it
+        self.device_poll_ms = device_poll_ms
         self.command_stores: Optional[CommandStores] = None
         # HLC state (reference: Node.uniqueNow CAS loop, local/Node.java:348)
         self._last_hlc = 0

@@ -778,7 +778,6 @@ class CommandStore:
         as PreAccept's under contention -- the slow path runs both)."""
         resolver = self.deps_resolver
         if resolver is None or not hasattr(resolver, "enqueue_deps") \
-                or not isinstance(seekables, Keys) \
                 or self.batch_window_ms is None:
             return success(self.calculate_deps(txn_id, seekables, before))
         return resolver.enqueue_deps(self, txn_id, seekables, before)
@@ -795,7 +794,6 @@ class CommandStore:
         ballot = ballot or Ballot.ZERO
         resolver = self.deps_resolver
         if resolver is None or not hasattr(resolver, "enqueue_preaccept") \
-                or not isinstance(partial_txn.keys, Keys) \
                 or self.batch_window_ms is None:
             return success(self._preaccept_now(txn_id, partial_txn, route, ballot))
         return resolver.enqueue_preaccept(self, txn_id, partial_txn, route,

@@ -324,6 +324,9 @@ class MaelstromNode:
             data_store=ListStore(),
             num_stores=2,
             progress_log_factory=engine.log_for,
+            # real deploy: wall-clock readiness polls harvest in-flight
+            # device calls early (no sim determinism to protect)
+            device_poll_ms=1.0,
         )
         engine.bind(self.node)
         self.emit(src, {"type": "init_ok", "in_reply_to": body.get("msg_id")})

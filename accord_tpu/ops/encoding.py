@@ -118,3 +118,55 @@ def encode_key_bitmaps(key_sets: Sequence[Sequence[int]], num_buckets: int) -> n
 
 def encode_kinds(txn_ids: Sequence[TxnId]) -> np.ndarray:
     return np.array([int(t.kind) for t in txn_ids], dtype=np.int32)
+
+
+# Half-open [start, end) intervals as int32 pairs for the range arena /
+# range-subject CSR. A _Successor endpoint (Range.point(k) ends "just above
+# k") encodes as k+1 -- exact for integer keys, where nothing orders strictly
+# between k and k+1. Non-integer or out-of-window endpoints are unencodable:
+# the resolver falls back to the host range scan for those (counted).
+_I32_MIN = -(1 << 31)
+_I32_MAX = (1 << 31) - 1
+
+
+def _encode_endpoint(p, successor: bool = False) -> Optional[int]:
+    from accord_tpu.primitives.keyspace import _Successor
+    if isinstance(p, _Successor):
+        p = p.key
+        successor = True
+    if not isinstance(p, (int, np.integer)):
+        return None
+    v = int(p) + 1 if successor else int(p)
+    if not (_I32_MIN < v < _I32_MAX):
+        return None
+    return v
+
+
+def encode_interval(r) -> Optional[Tuple[int, int]]:
+    """Range -> (start, end) int32 pair, or None when unencodable."""
+    s = _encode_endpoint(r.start)
+    e = _encode_endpoint(r.end)
+    if s is None or e is None:
+        return None
+    return s, e
+
+
+def encode_seekable_intervals(seekables) -> Optional[List[Tuple[int, int]]]:
+    """A subject's owned keys/ranges as interval pairs for the range kernel:
+    keys become point intervals [k, k+1). None when any piece is
+    unencodable (the caller answers that subject host-side)."""
+    from accord_tpu.primitives.keyspace import Keys
+    out: List[Tuple[int, int]] = []
+    if isinstance(seekables, Keys):
+        for k in seekables:
+            s = _encode_endpoint(k)
+            if s is None:
+                return None
+            out.append((s, s + 1))
+        return out
+    for r in seekables:
+        iv = encode_interval(r)
+        if iv is None:
+            return None
+        out.append(iv)
+    return out

@@ -76,6 +76,7 @@ class ExecPlane:
         self.releases = 0
         self.harvest_stall_s = 0.0
         self.prefetched = 0
+        self.upload_bytes = 0
 
     # -- row management ------------------------------------------------------
     def _row(self, txn_id: TxnId) -> int:
@@ -254,19 +255,28 @@ class ExecPlane:
 
     def on_status(self, cmd) -> None:
         """A command's status advanced (it may gate others): refresh its
-        dep-side lanes."""
+        dep-side lanes. Delta-aware: a hook that changes no lane (repeated
+        status bumps between ticks are common) dirties nothing, so the next
+        dispatch uploads only genuinely-changed rows."""
         if cmd.known_execute_at:
             self._ensure_window(cmd.execute_at)
         row = self.row_of.get(cmd.txn_id)
         if row is None:
             return
+        changed = False
         if cmd.known_execute_at and cmd.execute_at is not None:
-            self.exec_ts[row] = self._encode(cmd.execute_at)
+            enc = self._encode(cmd.execute_at)
+            if not np.array_equal(self.exec_ts[row], enc):
+                self.exec_ts[row] = enc
+                changed = True
         if cmd.has_been(Status.APPLIED) or cmd.status.is_terminal:
-            self.applied[row] = True
-            self.pending[row] = False
-        self._dirty.add(row)
-        self._schedule_tick()
+            if not self.applied[row] or self.pending[row]:
+                self.applied[row] = True
+                self.pending[row] = False
+                changed = True
+        if changed:
+            self._dirty.add(row)
+            self._schedule_tick()
 
     def on_edges_changed(self, cmd) -> None:
         """Floor/ownership elision rewrote the wait set: resync the row.
@@ -283,16 +293,19 @@ class ExecPlane:
         row = self.row_of.get(cmd.txn_id)
         if row is None:
             return  # compaction dropped it (no longer pending/referenced)
-        self.adj[row] = 0
+        new_adj = np.zeros_like(self.adj[row])
         for dep_id in dep_ids:
             d = self.row_of[dep_id]
-            self.adj[row, d >> 5] |= np.uint32(1 << (d & 31))
+            new_adj[d >> 5] |= np.uint32(1 << (d & 31))
+        if np.array_equal(new_adj, self.adj[row]):
+            return  # elision rewrote to the same edges: nothing to upload
+        self.adj[row] = new_adj
         self._dirty.add(row)
         self._schedule_tick()
 
     def on_erased(self, txn_id: TxnId) -> None:
         row = self.row_of.get(txn_id)
-        if row is None:
+        if row is None or (self.applied[row] and not self.pending[row]):
             return
         self.applied[row] = True   # an erased record gates nothing
         self.pending[row] = False
@@ -366,12 +379,12 @@ class ExecPlane:
             # never aliases the live host shadows (zero-copy aliasing on the
             # CPU backend raced host mutations and broke determinism)
             rows = np.asarray(sorted(self._dirty), dtype=np.int32)
+            uploads = (rows, self.adj[rows], self.exec_ts[rows],
+                       self.applied[rows], self.pending[rows],
+                       self.awaits_all[rows])
+            self.upload_bytes += sum(u.nbytes for u in uploads)
             self._device = exec_scatter(
-                *self._device, jnp.asarray(rows),
-                jnp.asarray(self.adj[rows]), jnp.asarray(self.exec_ts[rows]),
-                jnp.asarray(self.applied[rows]),
-                jnp.asarray(self.pending[rows]),
-                jnp.asarray(self.awaits_all[rows]))
+                *self._device, *(jnp.asarray(u) for u in uploads))
             self._dirty.clear()
         out = execution_frontier(*self._device)
         out.copy_to_host_async()

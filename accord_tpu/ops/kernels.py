@@ -214,35 +214,8 @@ def scatter_rows(dst, idx, rows):
     return dst.at[idx].set(rows)
 
 
-@jax.jit
-def deps_resolve(subj_keys, subj_before, subj_kinds,
-                 act_bitmaps, act_ts, act_kinds, act_valid,
-                 witness_table):
-    """The fused hot-path kernel: subject bitmaps built ON DEVICE from key
-    indices (uploading B x MAXK int32 instead of B x K float bitmaps -- the
-    host->device link is the bottleneck, see resolver.py), then the pairwise
-    conflict matrix, BIT-PACKED on device for the readback: 32 arena rows per
-    uint32 lane, so the transfer is B x cap/8 bytes regardless of how many
-    dependencies each subject has (a top-k index list was tried first: its
-    coverage/latency trade collapses under contention where counts reach
-    hundreds).
-
-    subj_keys:   i32[B, MAXK]  key bucket indices (already % K; -1 padding)
-    subj_before: i32[B, 3]     'started before' bound (3-lane encoding)
-    subj_kinds:  i32[B]
-    act_*:       the device arena (see resolver._NodeArena); cap % 32 == 0
-    -> u32[B, cap/32] packed dependency bitmask, little-bit-first per lane
-    """
-    onehot = (subj_keys[:, :, None]
-              == jnp.arange(act_bitmaps.shape[1], dtype=jnp.int32)[None, None, :]) \
-        & (subj_keys >= 0)[:, :, None]
-    subj_bm = onehot.any(axis=1).astype(jnp.bfloat16)
-    overlap = jax.lax.dot_general(
-        subj_bm, act_bitmaps.astype(jnp.bfloat16),
-        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) > 0.5
-    witness = witness_table[subj_kinds[:, None], act_kinds[None, :]] == 1
-    before = _lex_before(act_ts[None, :, :], subj_before[:, None, :])
-    m = overlap & witness & before & act_valid[None, :]
+def _pack_bits(m):
+    """bool[B, A] -> u32[B, A/32] little-bit-first per lane (A % 32 == 0)."""
     b, a = m.shape
     weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
     return jnp.sum(m.reshape(b, a // 32, 32).astype(jnp.uint32)
@@ -250,27 +223,135 @@ def deps_resolve(subj_keys, subj_before, subj_kinds,
 
 
 @jax.jit
-def arena_scatter(bitmaps, ts, exec_ts, kinds, valid,
-                  rows, keys_mod, ts_rows, exec_rows, kind_rows, valid_rows):
+def deps_resolve(subj_of, subj_keys, subj_before, subj_kinds,
+                 act_bitmaps, act_ts, act_kinds, act_valid,
+                 witness_table):
+    """The fused hot-path kernel: subject bitmaps built ON DEVICE from a
+    variable-width CSR key list (uploading 2 x nnz int32 instead of B x K
+    float bitmaps -- the host->device link is the bottleneck, see
+    resolver.py). The CSR replaces the old fixed i32[B, MAXK] scatter:
+    arbitrarily wide subjects stay on the device path instead of demoting to
+    a host residual scan. The pairwise conflict matrix is BIT-PACKED on
+    device for the readback: 32 arena rows per uint32 lane, so the transfer
+    is B x cap/8 bytes regardless of how many dependencies each subject has
+    (a top-k index list was tried first: its coverage/latency trade collapses
+    under contention where counts reach hundreds).
+
+    subj_of:     i32[nnz]      subject row per CSR entry (padding entries use
+                               B -- out of bounds, dropped by the scatter)
+    subj_keys:   i32[nnz]      key bucket indices (already % K)
+    subj_before: i32[B, 3]     'started before' bound per subject (3-lane
+                               encoding)
+    subj_kinds:  i32[B]
+    act_*:       the device arena (see resolver._NodeArena); cap % 32 == 0
+    -> u32[B, cap/32] packed dependency bitmask, little-bit-first per lane
+    """
+    b = subj_before.shape[0]
+    k = act_bitmaps.shape[1]
+    subj_bm = jnp.zeros((b, k), jnp.float32) \
+        .at[subj_of, subj_keys].max(1.0, mode="drop").astype(jnp.bfloat16)
+    overlap = jax.lax.dot_general(
+        subj_bm, act_bitmaps.astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) > 0.5
+    witness = witness_table[subj_kinds[:, None], act_kinds[None, :]] == 1
+    before = _lex_before(act_ts[None, :, :], subj_before[:, None, :])
+    m = overlap & witness & before & act_valid[None, :]
+    return _pack_bits(m)
+
+
+@jax.jit
+def range_deps_resolve(iv_of, iv_start, iv_end, subj_before, subj_kinds,
+                       subj_is_range,
+                       r_start, r_end, r_ts, r_kinds, r_valid,
+                       k_kmin, k_kmax, k_ts, k_kinds, k_valid,
+                       witness_table):
+    """The fused RANGE-overlap kernel: every subject carries a CSR list of
+    half-open int32 intervals (a key subject's keys become point intervals
+    [k, k+1); a range subject's owned ranges upload as-is), tested against
+
+      - the RANGE arena by branch-free interval overlap
+        (iv_start < r_end & r_start < iv_end), which for a point interval
+        degenerates to the stabbing test r_start <= key < r_end; and
+      - the KEY arena by a conservative span compare against each row's
+        [kmin, kmax] key hull (iv_start <= kmax & kmin < iv_end) -- range
+        subjects only (key subjects get exact key deps from deps_resolve);
+        the host decode filters span false positives per real key.
+
+    Sorted-endpoint broadcast compares beat an interval tree here: the tree's
+    pointer-chasing descent is serial and branchy, while [nv, rcap] compares
+    are pure VPU work XLA fuses with the witness/before masks.
+
+    iv_of:         i32[nv]   subject row per interval (padding -> B, dropped)
+    iv_start/end:  i32[nv]   half-open interval endpoints
+    subj_before:   i32[B, 3] 'started before' bound per subject
+    subj_kinds:    i32[B]
+    subj_is_range: bool[B]   True for range-domain subjects (gates the
+                             key-arena output)
+    r_*:           the range arena (resolver._RangeArena); rcap % 32 == 0
+    k_*:           the key arena span lanes; cap % 32 == 0
+    -> (u32[B, rcap/32], u32[B, cap/32]) packed candidate bitmasks, masked by
+       witness/before/valid exactly like deps_resolve
+    """
+    b = subj_before.shape[0]
+    rcap = r_start.shape[0]
+    cap = k_kmin.shape[0]
+    hit_r = (iv_start[:, None] < r_end[None, :]) \
+        & (r_start[None, :] < iv_end[:, None])
+    any_r = jnp.zeros((b, rcap), jnp.int32) \
+        .at[iv_of].max(hit_r.astype(jnp.int32), mode="drop") > 0
+    witness_r = witness_table[subj_kinds[:, None], r_kinds[None, :]] == 1
+    before_r = _lex_before(r_ts[None, :, :], subj_before[:, None, :])
+    m_r = any_r & witness_r & before_r & r_valid[None, :]
+    hit_k = (iv_start[:, None] <= k_kmax[None, :]) \
+        & (k_kmin[None, :] < iv_end[:, None])
+    any_k = jnp.zeros((b, cap), jnp.int32) \
+        .at[iv_of].max(hit_k.astype(jnp.int32), mode="drop") > 0
+    witness_k = witness_table[subj_kinds[:, None], k_kinds[None, :]] == 1
+    before_k = _lex_before(k_ts[None, :, :], subj_before[:, None, :])
+    m_k = any_k & witness_k & before_k & k_valid[None, :] \
+        & subj_is_range[:, None]
+    return _pack_bits(m_r), _pack_bits(m_k)
+
+
+@jax.jit
+def arena_scatter(bitmaps, ts, exec_ts, kinds, kmin, kmax, valid,
+                  rows, key_rows, key_mods, ts_rows, exec_rows, kind_rows,
+                  kmin_rows, kmax_rows, valid_rows):
     """Scatter dirty rows into the device arena. Bitmap rows are rebuilt on
-    device from key indices (i32[n, MAXK], -1 padded) so the upload is tiny.
-    Padding duplicates row[0] with identical data -- harmless double write."""
-    onehot = (keys_mod[:, :, None]
-              == jnp.arange(bitmaps.shape[1], dtype=jnp.int32)[None, None, :]) \
-        & (keys_mod >= 0)[:, :, None]
-    bm_rows = onehot.any(axis=1).astype(bitmaps.dtype)
-    return (bitmaps.at[rows].set(bm_rows),
+    device from a CSR key list (key_rows i32[nnz] holds ABSOLUTE arena row
+    indices; padding entries use cap -- out of bounds, dropped): each dirty
+    row's bitmap is zeroed, then its current buckets scatter-set, so rows
+    whose key sets shrank lose their stale bits. Row-padding duplicates
+    row[0] with identical lane data -- harmless double write."""
+    cleared = bitmaps.at[rows].set(0.0)
+    return (cleared.at[key_rows, key_mods].max(1.0, mode="drop"),
             ts.at[rows].set(ts_rows),
             exec_ts.at[rows].set(exec_rows),
+            kinds.at[rows].set(kind_rows),
+            kmin.at[rows].set(kmin_rows),
+            kmax.at[rows].set(kmax_rows),
+            valid.at[rows].set(valid_rows))
+
+
+@jax.jit
+def range_scatter(starts, ends, ts, kinds, valid,
+                  rows, start_rows, end_rows, ts_rows, kind_rows, valid_rows):
+    """Scatter dirty rows into the range arena (tiny flat lanes -- one
+    interval per row). Padding duplicates row[0]; harmless double write."""
+    return (starts.at[rows].set(start_rows),
+            ends.at[rows].set(end_rows),
+            ts.at[rows].set(ts_rows),
             kinds.at[rows].set(kind_rows),
             valid.at[rows].set(valid_rows))
 
 
 @functools.partial(jax.jit, static_argnames=("new_cap",))
-def arena_grow(bitmaps, ts, exec_ts, kinds, valid, new_cap: int):
+def arena_grow(bitmaps, ts, exec_ts, kinds, kmin, kmax, valid, new_cap: int):
     """Double the arena capacity ON DEVICE (zero/neg padding) -- re-uploading
-    a full [cap, K] bitmap over the host link would cost seconds."""
+    a full [cap, K] bitmap over the host link would cost seconds. Empty-row
+    key hulls pad to [INT32_MAX, INT32_MIN] so no interval can overlap them."""
     neg = jnp.int32(np.iinfo(np.int32).min)
+    pos = jnp.int32(np.iinfo(np.int32).max)
     grow = new_cap - bitmaps.shape[0]
 
     def pad(a, value=0):
@@ -278,7 +359,7 @@ def arena_grow(bitmaps, ts, exec_ts, kinds, valid, new_cap: int):
         return jnp.pad(a, widths, constant_values=value)
 
     return (pad(bitmaps), pad(ts), pad(exec_ts, neg), pad(kinds),
-            pad(valid, False))
+            pad(kmin, pos), pad(kmax, neg), pad(valid, False))
 
 
 def pad_to(x: np.ndarray, size: int, axis: int = 0) -> np.ndarray:
@@ -308,8 +389,45 @@ SUBJECT_TIERS = (8, 64, 128)
 
 
 def subject_tier(n: int) -> int:
-    """Padded subject-batch size for a dispatch of n subject chunks."""
+    """Padded subject-batch size for a dispatch of n subjects."""
     for tier in SUBJECT_TIERS:
         if n <= tier:
             return tier
     return bucket_size(n, 256)
+
+
+# CSR flat-entry padding ladders. The subject CSR (one entry per owned key /
+# owned interval) pads to NNZ_TIERS; the dirty-row scatter CSR packs rows
+# greedily under SCATTER_NNZ_TIERS[-1] entries per chunk so both the row tier
+# ({8, 64}) and the nnz tier stay warmable. Oversized singles fall onto
+# power-of-two buckets.
+NNZ_TIERS = (32, 256, 2048)
+SCATTER_NNZ_TIERS = (64, 512)
+
+
+def nnz_tier(n: int) -> int:
+    """Padded CSR entry count for a dispatch carrying n subject entries."""
+    for tier in NNZ_TIERS:
+        if n <= tier:
+            return tier
+    return bucket_size(n, 4096)
+
+
+def scatter_nnz_tier(n: int) -> int:
+    """Padded CSR entry count for an arena-scatter chunk of n key entries."""
+    for tier in SCATTER_NNZ_TIERS:
+        if n <= tier:
+            return tier
+    return bucket_size(n, 1024)
+
+
+def jit_cache_sizes() -> dict:
+    """Compiled-variant counts of the warmable hot-path kernels: the bench
+    snapshots this around its timed windows to assert warmup() covered every
+    jit tier the pipeline dispatches (0 recompiles while timing)."""
+    return {
+        "deps_resolve": deps_resolve._cache_size(),
+        "range_deps_resolve": range_deps_resolve._cache_size(),
+        "arena_scatter": arena_scatter._cache_size(),
+        "range_scatter": range_scatter._cache_size(),
+    }

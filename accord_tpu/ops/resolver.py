@@ -17,10 +17,20 @@ Why the shape of this design (measured on the target TPU-via-tunnel setup):
     a full tunnel round trip (~110 ms), while ASYNC copies pipeline almost
     perfectly (~5-8 ms marginal per in-flight call);
   - the host->device link is slow (~5 MB/s), so the arena is maintained by
-    scattering KEY INDICES (i32[n, MAXK]) and rebuilding bitmap rows on
-    device, and results come back BIT-PACKED (u32[B, cap/32], 8x smaller
-    than a boolean matrix and independent of how many deps each subject
-    has).
+    scattering a variable-width CSR of KEY INDICES (flat i32[nnz]) and
+    rebuilding bitmap rows on device, and results come back BIT-PACKED
+    (u32[B, cap/32], 8x smaller than a boolean matrix and independent of how
+    many deps each subject has).
+
+Range txns live in a SECOND device mirror (_RangeArena): active ranges as
+sorted-endpoint int32 pairs, one row per (txn, interval). Every dispatch that
+touches range state also runs the fused range_deps_resolve kernel -- key
+subjects stab the interval rows with point intervals, range subjects overlap
+both the interval rows and the key arena's per-row [kmin, kmax] key hulls --
+so range-domain subjects ride the same dispatch/harvest pipeline and the old
+per-harvest host scans (host_range_deps union, the > MAXK host_only residual)
+are retired. Decode stays exact: candidate rows translate to txn ids and are
+re-filtered host-side per real key/range before entering the Deps.
 
 Async protocol (deterministic, overlapped): a node tick drains every store's
 queued PreAccepts/deps queries, runs the host-side preaccept transitions
@@ -47,12 +57,29 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from accord_tpu.local.cfk import CfkStatus
-from accord_tpu.ops.encoding import TimestampEncoder, WITNESS_TABLE
-from accord_tpu.primitives.deps import Deps, KeyDepsBuilder
-from accord_tpu.primitives.keyspace import Keys, Seekables
+from accord_tpu.ops.encoding import (TimestampEncoder, WITNESS_TABLE,
+                                     encode_interval,
+                                     encode_seekable_intervals)
+from accord_tpu.primitives.deps import Deps, KeyDepsBuilder, RangeDepsBuilder
+from accord_tpu.primitives.keyspace import Keys, Range, Ranges, Seekables
 from accord_tpu.primitives.timestamp import Timestamp, TxnId
 from accord_tpu.utils.async_ import AsyncResult, success
 from accord_tpu.utils.invariants import Invariants
+
+
+_EMPTY_I32 = np.empty(0, dtype=np.int32)
+_EMPTY_I64 = np.empty(0, dtype=np.int64)
+
+
+def _unpack_row(prow: np.ndarray) -> np.ndarray:
+    """One subject's packed u32 result row -> int64 arena row indices."""
+    wnz = np.nonzero(prow)[0]
+    if wnz.size == 0:
+        return _EMPTY_I64
+    sub = np.unpackbits(prow[wnz].astype("<u4").view(np.uint8),
+                        bitorder="little").reshape(wnz.size, 32)
+    rr, cc = np.nonzero(sub)
+    return (wnz[rr].astype(np.int64) << 5) | cc
 
 
 class DepsResolver:
@@ -84,32 +111,67 @@ class HostDepsResolver(DepsResolver):
 
 
 def warmup(num_buckets: int = 1024, cap: int = 8192,
-           batch_tiers=(8, 64, 128), scatter_tiers=(8, 64)) -> None:
+           batch_tiers=(8, 64, 128), scatter_tiers=(8, 64),
+           nnz_tiers=None, scatter_nnz_tiers=None,
+           range_cap: int = 64) -> None:
     """Pre-compile the jit shape tiers the async pipeline uses (first
     compilation costs seconds on a tunnelled TPU; production would do the
     same at process start). The jit cache is process-global, so one call
-    covers every resolver with the same (num_buckets, cap)."""
+    covers every resolver with the same (num_buckets, cap, range_cap).
+
+    The CSR encoding makes each kernel's shape a (batch tier, nnz tier)
+    PAIR, so warmup compiles the cross product -- a handful of variants,
+    bounded by the deliberately short tier ladders in ops/kernels.py. The
+    bench asserts zero recompiles inside its timed window against exactly
+    this coverage (kernels.jit_cache_sizes)."""
     import jax.numpy as jnp
-    from accord_tpu.ops.kernels import arena_scatter, deps_resolve
+    from accord_tpu.ops.kernels import (NNZ_TIERS, SCATTER_NNZ_TIERS,
+                                        arena_scatter, deps_resolve,
+                                        range_deps_resolve, range_scatter)
+    if nnz_tiers is None:
+        nnz_tiers = NNZ_TIERS
+    if scatter_nnz_tiers is None:
+        scatter_nnz_tiers = SCATTER_NNZ_TIERS
     neg = np.iinfo(np.int32).min
+    pos = np.iinfo(np.int32).max
     bm = jnp.zeros((cap, num_buckets), jnp.float32)
     ts = jnp.zeros((cap, 3), jnp.int32)
     ex = jnp.full((cap, 3), neg, jnp.int32)
     kd = jnp.zeros(cap, jnp.int32)
+    kmin = jnp.full(cap, pos, jnp.int32)
+    kmax = jnp.full(cap, neg, jnp.int32)
     vl = jnp.zeros(cap, bool)
+    rs = jnp.zeros(range_cap, jnp.int32)
+    re_ = jnp.zeros(range_cap, jnp.int32)
+    rts = jnp.zeros((range_cap, 3), jnp.int32)
+    rkd = jnp.zeros(range_cap, jnp.int32)
+    rvl = jnp.zeros(range_cap, bool)
     table = jnp.asarray(WITNESS_TABLE)
     out = None
     for m in scatter_tiers:
-        out = arena_scatter(
-            bm, ts, ex, kd, vl, jnp.zeros(m, jnp.int32),
-            jnp.full((m, _NodeArena.MAXK), -1, jnp.int32),
-            jnp.zeros((m, 3), jnp.int32), jnp.zeros((m, 3), jnp.int32),
-            jnp.zeros(m, jnp.int32), jnp.zeros(m, bool))
+        for z in scatter_nnz_tiers:
+            out = arena_scatter(
+                bm, ts, ex, kd, kmin, kmax, vl, jnp.zeros(m, jnp.int32),
+                jnp.full(z, cap, jnp.int32), jnp.zeros(z, jnp.int32),
+                jnp.zeros((m, 3), jnp.int32), jnp.zeros((m, 3), jnp.int32),
+                jnp.zeros(m, jnp.int32), jnp.full(m, pos, jnp.int32),
+                jnp.full(m, neg, jnp.int32), jnp.zeros(m, bool))
+        out = range_scatter(
+            rs, re_, rts, rkd, rvl, jnp.zeros(m, jnp.int32),
+            jnp.zeros(m, jnp.int32), jnp.zeros(m, jnp.int32),
+            jnp.zeros((m, 3), jnp.int32), jnp.zeros(m, jnp.int32),
+            jnp.zeros(m, bool))
     for b in batch_tiers:
-        out = deps_resolve(
-            jnp.full((b, _NodeArena.MAXK), -1, jnp.int32),
-            jnp.zeros((b, 3), jnp.int32), jnp.zeros(b, jnp.int32),
-            bm, ts, kd, vl, table)
+        sb = jnp.zeros((b, 3), jnp.int32)
+        sknd = jnp.zeros(b, jnp.int32)
+        srng = jnp.zeros(b, bool)
+        for z in nnz_tiers:
+            of = jnp.full(z, b, jnp.int32)
+            zz = jnp.zeros(z, jnp.int32)
+            out = deps_resolve(of, zz, sb, sknd, bm, ts, kd, vl, table)
+            out = range_deps_resolve(of, zz, zz, sb, sknd, srng,
+                                     rs, re_, rts, rkd, rvl,
+                                     kmin, kmax, ts, kd, vl, table)
     if out is not None:
         import jax
         jax.block_until_ready(out)
@@ -122,14 +184,17 @@ class _NodeArena:
     recovery at harvest filters cross-store/bucket false positives).
 
     Device arrays (authoritative once scattered): bitmaps f32[cap, K],
-    ts i32[cap, 3], exec_ts i32[cap, 3], kinds i32[cap], valid bool[cap].
-    Host shadows exist only to source dirty-row scatters and exact key sets.
+    ts i32[cap, 3], exec_ts i32[cap, 3], kinds i32[cap], kmin/kmax i32[cap]
+    (the row's [min key, max key] hull, for range-subject overlap), valid
+    bool[cap]. Host shadows exist only to source dirty-row scatters and
+    exact key sets. Key lists upload as a variable-width CSR, so arbitrarily
+    wide rows stay on the device path (no MAXK demotion, no host residual).
     """
 
-    MAXK = 16   # key indices per scatter row; wider rows go host_only
     GROW = 2
 
-    def __init__(self, num_buckets: int, initial_cap: int = 4096):
+    def __init__(self, num_buckets: int, initial_cap: int = 4096,
+                 range_cap: int = 64):
         self.num_buckets = num_buckets
         self.cap = initial_cap
         self.count = 0
@@ -147,18 +212,20 @@ class _NodeArena:
                                dtype=np.int32)
         self.kinds = np.zeros(self.cap, dtype=np.int32)
         self.valid = np.zeros(self.cap, dtype=bool)
-        self.keys_mod = np.full((self.cap, self.MAXK), -1, dtype=np.int32)
+        # variable-width CSR source: sorted unique key-bucket indices per row
+        self.row_mods: List[np.ndarray] = []
+        # per-row [min, max] key hull (int-clamped): the range kernel's
+        # conservative span test against range subjects. Empty rows pad to
+        # [INT32_MAX, INT32_MIN] so no interval can overlap them
+        self.kmin = np.full(self.cap, np.iinfo(np.int32).max, dtype=np.int32)
+        self.kmax = np.full(self.cap, np.iinfo(np.int32).min, dtype=np.int32)
         # per-KEY packed row bitmask (u32[cap/32]): which arena rows touch
         # the key. AND-ing it with a subject's packed dependency row yields
         # that key's dependency rows with pure numpy -- the vectorized CSR
         # decode that makes the device path cheaper than the host scan
         self.key_rows: Dict[object, np.ndarray] = {}
-        # rows whose key set exceeds MAXK: excluded from the device (valid
-        # False) and scanned host-side at harvest (rare)
-        self.host_only: set = set()
         # rows of INVALIDATED txns: the device excludes them via the valid
-        # lane; the host_only scan must exclude them too (the `valid` lane is
-        # overloaded -- it is also false for host_only/emptied rows)
+        # lane (the `valid` lane is overloaded -- also false for emptied rows)
         self.invalidated: set = set()
         # once any truncation shrank a row, the device bitmap may understate
         # historical key coverage -- the (monotone) max-conflict kernel must
@@ -177,6 +244,12 @@ class _NodeArena:
         # ts[row] is written once at row creation, so it only invalidates on
         # compaction (gen) or growth of the live prefix (count)
         self._rank = None
+        # bytes shipped host->device by dirty-row scatters (bench counter)
+        self.upload_bytes = 0
+        # the node's ACTIVE RANGE TXNS, mirrored as interval rows; shares
+        # this arena's timestamp encoder so the kernels' before-compares are
+        # in one window
+        self.ranges = _RangeArena(self, range_cap)
 
     # -- host-side mutation ---------------------------------------------------
     def _ensure_encoder(self, ts: Timestamp) -> None:
@@ -196,9 +269,10 @@ class _NodeArena:
                               constant_values=np.iinfo(np.int32).min)
         self.kinds = np.pad(self.kinds, (0, new_cap - self.cap))
         self.valid = np.pad(self.valid, (0, new_cap - self.cap))
-        self.keys_mod = np.pad(self.keys_mod,
-                               ((0, new_cap - self.cap), (0, 0)),
-                               constant_values=-1)
+        self.kmin = np.pad(self.kmin, (0, new_cap - self.cap),
+                           constant_values=np.iinfo(np.int32).max)
+        self.kmax = np.pad(self.kmax, (0, new_cap - self.cap),
+                           constant_values=np.iinfo(np.int32).min)
         for k in self.key_rows:
             self.key_rows[k] = np.pad(self.key_rows[k],
                                       (0, (new_cap - self.cap) // 32))
@@ -232,13 +306,14 @@ class _NodeArena:
         self.exec_max = []
         self.row_of = {}
         self.key_rows = {}
-        self.host_only = set()
+        self.row_mods = []
         self.invalidated = set()
         self.ts[:] = 0
         self.exec_ts[:] = np.iinfo(np.int32).min
         self.kinds[:] = 0
         self.valid[:] = False
-        self.keys_mod[:] = -1
+        self.kmin[:] = np.iinfo(np.int32).max
+        self.kmax[:] = np.iinfo(np.int32).min
         for old_row in live:
             row = self.count
             self.count += 1
@@ -251,14 +326,13 @@ class _NodeArena:
             self.exec_ts[row] = old_exec_ts[old_row]
             self.kinds[row] = old_kinds[old_row]
             # validity is RECOMPUTED, not copied: the old lane is overloaded
-            # (false for invalidated AND host_only rows), and a formerly
-            # host_only row whose key set shrank to <= MAXK must re-enter
-            # the device path -- copying would strand it invisible to both
-            # the kernel and the host_only supplement scan
+            # (false for invalidated AND emptied rows) -- copying would
+            # strand a still-live row invisible to the kernel
             self.valid[row] = old_row not in old_invalidated
             if old_row in old_invalidated:
                 self.invalidated.add(row)
-            self._set_row_keys(row)   # demotes >MAXK rows to host_only
+            self.row_mods.append(None)
+            self._set_row_keys(row)
             for k in old_keys[old_row]:
                 self._set_key_row_bit(k, row)
         self._device = None
@@ -337,6 +411,7 @@ class _NodeArena:
             self.ts[row] = self.encoder.encode_one(txn_id)
             self.kinds[row] = int(txn_id.kind)
             self.valid[row] = True
+            self.row_mods.append(None)
             self._set_row_keys(row)
             for k in key_set:
                 self._set_key_row_bit(k, row)
@@ -363,13 +438,19 @@ class _NodeArena:
 
     def _set_row_keys(self, row: int) -> None:
         ks = self.key_sets[row]
-        if len(ks) > self.MAXK:
-            self.host_only.add(row)
-            self.valid[row] = False
+        if not ks:
+            self.row_mods[row] = _EMPTY_I32
+            self.kmin[row] = np.iinfo(np.int32).max
+            self.kmax[row] = np.iinfo(np.int32).min
             return
-        mods = sorted({int(k) % self.num_buckets for k in ks})
-        self.keys_mod[row] = -1
-        self.keys_mod[row, :len(mods)] = mods
+        ints = [int(k) for k in ks]
+        mods = sorted({v % self.num_buckets for v in ints})
+        self.row_mods[row] = np.asarray(mods, dtype=np.int32)
+        # hull clamped to int32: an out-of-range key can never be stabbed by
+        # an ENCODABLE subject interval (endpoints are strictly inside the
+        # int32 range), so clamping loses nothing the device could see
+        self.kmin[row] = max(min(ints), np.iinfo(np.int32).min)
+        self.kmax[row] = min(max(ints), np.iinfo(np.int32).max)
 
     def _set_key_row_bit(self, key, row: int) -> None:
         kr = self.key_rows.get(key)
@@ -481,42 +562,342 @@ class _NodeArena:
             self._clear_key_row_bit(k, row)
         self.key_sets[row] = remaining
         self.had_truncation = True
+        self._set_row_keys(row)
         if not remaining:
             self.valid[row] = False
-            self.host_only.discard(row)
-        else:
-            self._set_row_keys(row)
         self._dirty_rows.add(row)
 
     # -- device sync ----------------------------------------------------------
     def device_arrays(self):
         import jax.numpy as jnp
-        from accord_tpu.ops.kernels import arena_scatter, bucket_size
         if self._device is None:
             neg = np.iinfo(np.int32).min
+            pos = np.iinfo(np.int32).max
             self._device = (
                 jnp.zeros((self.cap, self.num_buckets), jnp.float32),
                 jnp.zeros((self.cap, 3), jnp.int32),
                 jnp.full((self.cap, 3), neg, jnp.int32),
+                jnp.zeros(self.cap, jnp.int32),
+                jnp.full(self.cap, pos, jnp.int32),
+                jnp.full(self.cap, neg, jnp.int32),
+                jnp.zeros(self.cap, bool),
+            )
+            self._dirty_rows = set(range(self.count))
+        if self._dirty_rows:
+            rows = sorted(self._dirty_rows)
+            # greedy chunks bounded in BOTH rows (<= 64) and flat CSR key
+            # entries (<= SCATTER_NNZ_TIERS[-1]) so the jit shape tiers stay
+            # few and warmable; a single ultra-wide row gets its own
+            # power-of-two nnz bucket
+            lo = 0
+            while lo < len(rows):
+                hi = lo + 1
+                nnz = len(self.row_mods[rows[lo]])
+                while hi < len(rows) and hi - lo < 64:
+                    w = len(self.row_mods[rows[hi]])
+                    if nnz + w > 512:
+                        break
+                    nnz += w
+                    hi += 1
+                self._scatter_chunk(rows[lo:hi])
+                lo = hi
+            self._dirty_rows.clear()
+        return self._device
+
+    def _scatter_chunk(self, chunk: List[int]) -> None:
+        import jax.numpy as jnp
+        from accord_tpu.ops.kernels import arena_scatter, scatter_nnz_tier
+        m = 8 if len(chunk) <= 8 else 64
+        # pad by repeating the first dirty row: duplicate scatter indexes
+        # write identical (correct) data -- harmless (the bitmap scatter is
+        # clear-then-max, so double writes commute)
+        idx = np.full(m, chunk[0], dtype=np.int32)
+        idx[:len(chunk)] = chunk
+        mods_list = [self.row_mods[r] for r in chunk]
+        counts = np.fromiter((len(a) for a in mods_list), np.int64,
+                             len(chunk))
+        total = int(counts.sum())
+        z = scatter_nnz_tier(total)
+        # CSR padding entries use row index == cap: out of bounds, dropped
+        key_rows = np.full(z, self.cap, dtype=np.int32)
+        key_mods = np.zeros(z, dtype=np.int32)
+        if total:
+            key_rows[:total] = np.repeat(np.asarray(chunk, np.int32), counts)
+            key_mods[:total] = np.concatenate(mods_list)
+        uploads = (idx, key_rows, key_mods, self.ts[idx], self.exec_ts[idx],
+                   self.kinds[idx], self.kmin[idx], self.kmax[idx],
+                   self.valid[idx])
+        self.upload_bytes += sum(a.nbytes for a in uploads)
+        self._device = arena_scatter(
+            *self._device, *(jnp.asarray(a) for a in uploads))
+
+
+class _RangeArena:
+    """Incremental device mirror of one NODE's active RANGE-TXN set: one row
+    per (txn, interval), interval endpoints normalized to half-open int32
+    pairs (a _Successor endpoint encodes as key+1 -- exact for integer key
+    domains). Owned by a _NodeArena and sharing its timestamp encoder, so the
+    range kernel's before-compares live in the same window as the key arena.
+
+    Sorted-endpoint pairs instead of an interval tree: the kernel tests every
+    (subject interval, row) pair with a branch-free broadcast compare -- pure
+    VPU work -- where a tree descent would be serial and branchy on device.
+
+    Device lanes: starts/ends i32[rcap], ts i32[rcap, 3], kinds i32[rcap],
+    valid bool[rcap]. The device result is a CANDIDATE set: the harvest
+    decode re-filters per real range against store.range_txns, which also
+    makes freed-row reuse between dispatch and harvest safe (a wrong-id
+    candidate fails the host re-check exactly like a bucket collision).
+
+    A non-integer / out-of-window endpoint flips `encode_ok` False
+    permanently: the node reverts to the host range scans (counted by the
+    resolver as range_fallbacks; never hit by the integer key domains the
+    burns and benches use)."""
+
+    GROW = 2
+
+    def __init__(self, owner: "_NodeArena", initial_cap: int = 64):
+        self.owner = owner
+        self.cap = initial_cap          # multiple of 32 (and, sharded, of
+                                        # 32*data -- see ShardedBatchDepsResolver)
+        self.count = 0                  # high-water row mark
+        self.ids_np = np.empty(self.cap, dtype=object)
+        self.rows_of: Dict[TxnId, List[int]] = {}
+        # node-level union of each txn's registered ranges (stores register
+        # their slices separately; deps recovery re-slices per store)
+        self.ranges_of: Dict[TxnId, Ranges] = {}
+        self._encoded_of: Dict[TxnId, List[Tuple[int, int]]] = {}
+        self.starts = np.zeros(self.cap, dtype=np.int32)
+        self.ends = np.zeros(self.cap, dtype=np.int32)
+        self.ts = np.zeros((self.cap, 3), dtype=np.int32)
+        self.kinds = np.zeros(self.cap, dtype=np.int32)
+        self.valid = np.zeros(self.cap, dtype=bool)
+        self.invalidated_ids: set = set()
+        self.encode_ok = True
+        self._free: List[int] = []
+        self._dirty_rows: set = set()
+        self._device = None
+        self.upload_bytes = 0
+        # generation pinning across compact(), mirroring _NodeArena: stale
+        # harvests translate candidate rows BY TXN ID via the pinned
+        # snapshot (no row translation needed -- decode re-filters against
+        # current store state anyway)
+        self.gen = 0
+        self.retired_ids: Dict[int, np.ndarray] = {}
+        self._gen_pins: Dict[int, int] = {}
+
+    # -- host-side mutation ---------------------------------------------------
+    def update(self, txn_id: TxnId, rngs: Ranges, status: CfkStatus) -> None:
+        if not self.encode_ok:
+            return
+        if status == CfkStatus.INVALIDATED:
+            self.invalidate(txn_id)
+            return
+        if txn_id in self.invalidated_ids:
+            return  # invalidation is terminal
+        prev = self.ranges_of.get(txn_id)
+        merged = rngs if prev is None else prev.union(rngs)
+        encoded = []
+        for r in merged:
+            iv = encode_interval(r)
+            if iv is None:
+                self.encode_ok = False
+                return
+            encoded.append(iv)
+        if encoded == self._encoded_of.get(txn_id):
+            self.ranges_of[txn_id] = merged
+            return  # ts/kind are txn-id-fixed; nothing device-visible changed
+        self.owner._ensure_encoder(txn_id)
+        Invariants.check_state(self.owner.encoder.in_window(txn_id),
+                               "active range txn %s outside encoder window",
+                               txn_id)
+        self._set_rows(txn_id, merged, encoded)
+
+    def invalidate(self, txn_id: TxnId) -> None:
+        """Terminal: drop the txn's rows (a dep that never applies). The
+        host's range map keeps max-conflict monotonicity, not the arena."""
+        self.invalidated_ids.add(txn_id)
+        self._drop_rows(txn_id)
+
+    def truncate(self, store, txn_id: TxnId) -> None:
+        """A store truncated its record of txn_id: subtract that store's
+        slice; other stores' pieces of the row set live on."""
+        cur = self.ranges_of.get(txn_id)
+        if cur is None:
+            return
+        mine = cur.intersection(store.slice_ranges)
+        if mine.is_empty():
+            return
+        remaining = cur.difference(mine)
+        if remaining.is_empty():
+            self._drop_rows(txn_id)
+            return
+        encoded = [encode_interval(r) for r in remaining]
+        if any(iv is None for iv in encoded):
+            # a slice boundary produced an unencodable endpoint: revert the
+            # node to the host range scan (same rule as update)
+            self.encode_ok = False
+            return
+        self._set_rows(txn_id, remaining, encoded)
+
+    def _drop_rows(self, txn_id: TxnId) -> None:
+        for r in self.rows_of.pop(txn_id, []):
+            self.valid[r] = False
+            self.ids_np[r] = None
+            self._free.append(r)
+            self._dirty_rows.add(r)
+        self.ranges_of.pop(txn_id, None)
+        self._encoded_of.pop(txn_id, None)
+
+    def _set_rows(self, txn_id: TxnId, merged: Ranges,
+                  encoded: List[Tuple[int, int]]) -> None:
+        old = self.rows_of.get(txn_id, [])
+        # ensure capacity BEFORE mutating: compaction rebuilds from
+        # ranges_of, so it must not run while this txn's rows are half-moved
+        if len(self._free) + len(old) + (self.cap - self.count) \
+                < len(encoded):
+            self.compact()
+            old = self.rows_of.get(txn_id, [])
+        while len(self._free) + len(old) + (self.cap - self.count) \
+                < len(encoded):
+            self._grow()
+        for r in old:
+            self.valid[r] = False
+            self.ids_np[r] = None
+            self._free.append(r)
+            self._dirty_rows.add(r)
+        enc3 = self.owner.encoder.encode_one(txn_id)
+        rows = []
+        for (s, e) in encoded:
+            row = self._free.pop() if self._free else self._alloc_tail()
+            self.starts[row] = s
+            self.ends[row] = e
+            self.ts[row] = enc3
+            self.kinds[row] = int(txn_id.kind)
+            self.valid[row] = True
+            self.ids_np[row] = txn_id
+            rows.append(row)
+            self._dirty_rows.add(row)
+        self.rows_of[txn_id] = rows
+        self.ranges_of[txn_id] = merged
+        self._encoded_of[txn_id] = encoded
+
+    def _alloc_tail(self) -> int:
+        row = self.count
+        self.count += 1
+        return row
+
+    def _grow(self) -> None:
+        new_cap = self.cap * self.GROW
+        ids = np.empty(new_cap, dtype=object)
+        ids[:self.cap] = self.ids_np
+        self.ids_np = ids
+        self.starts = np.pad(self.starts, (0, new_cap - self.cap))
+        self.ends = np.pad(self.ends, (0, new_cap - self.cap))
+        self.ts = np.pad(self.ts, ((0, new_cap - self.cap), (0, 0)))
+        self.kinds = np.pad(self.kinds, (0, new_cap - self.cap))
+        self.valid = np.pad(self.valid, (0, new_cap - self.cap))
+        self.cap = new_cap
+        # tiny lanes: re-upload wholesale rather than arena_grow on device
+        self._device = None
+
+    def compact(self) -> bool:
+        """Repack live rows densely, rebuilding from ranges_of (the
+        authoritative host map). Returns False when that would reclaim less
+        than half the capacity. Bumps `gen`; pinned in-flight calls keep the
+        retiring row->txn snapshot for id-based candidate translation."""
+        live = [(t, self._encoded_of[t]) for t in self.ranges_of]
+        need = sum(len(e) for _, e in live)
+        if need > self.cap // 2:
+            return False
+        if self._gen_pins.get(self.gen):
+            self.retired_ids[self.gen] = self.ids_np[:self.count].copy()
+        self.count = 0
+        self.ids_np[:] = None
+        self.rows_of = {}
+        self._free = []
+        self.starts[:] = 0
+        self.ends[:] = 0
+        self.ts[:] = 0
+        self.kinds[:] = 0
+        self.valid[:] = False
+        for t, encoded in live:
+            enc3 = self.owner.encoder.encode_one(t)
+            rows = []
+            for (s, e) in encoded:
+                row = self._alloc_tail()
+                self.starts[row] = s
+                self.ends[row] = e
+                self.ts[row] = enc3
+                self.kinds[row] = int(t.kind)
+                self.valid[row] = True
+                self.ids_np[row] = t
+                rows.append(row)
+            self.rows_of[t] = rows
+        self._device = None
+        self._dirty_rows = set()
+        self.gen += 1
+        return True
+
+    # -- in-flight generation pinning -----------------------------------------
+    def pin_gen(self) -> int:
+        self._gen_pins[self.gen] = self._gen_pins.get(self.gen, 0) + 1
+        return self.gen
+
+    def unpin_gen(self, gen: int) -> None:
+        left = self._gen_pins.get(gen, 0) - 1
+        if left > 0:
+            self._gen_pins[gen] = left
+        else:
+            self._gen_pins.pop(gen, None)
+            if gen != self.gen:
+                self.retired_ids.pop(gen, None)
+
+    def candidate_ids(self, gen: int, rows: np.ndarray) -> Optional[list]:
+        """Packed-result rows (possibly addressed in a retired generation)
+        -> deduped candidate txn ids, in row order. None when the snapshot
+        is gone (the caller falls back to the host scan; counted)."""
+        if gen == self.gen:
+            ids = self.ids_np
+        else:
+            ids = self.retired_ids.get(gen)
+            if ids is None:
+                return None
+            rows = rows[rows < ids.size]
+        out = []
+        seen = set()
+        for r in rows:
+            t = ids[r]
+            if t is not None and t not in seen:
+                seen.add(t)
+                out.append(t)
+        return out
+
+    # -- device sync ----------------------------------------------------------
+    def device_arrays(self):
+        import jax.numpy as jnp
+        from accord_tpu.ops.kernels import range_scatter
+        if self._device is None:
+            self._device = (
+                jnp.zeros(self.cap, jnp.int32),
+                jnp.zeros(self.cap, jnp.int32),
+                jnp.zeros((self.cap, 3), jnp.int32),
                 jnp.zeros(self.cap, jnp.int32),
                 jnp.zeros(self.cap, bool),
             )
             self._dirty_rows = set(range(self.count))
         if self._dirty_rows:
             rows = sorted(self._dirty_rows)
-            # chunked so the jit shape tiers stay few and warmable ({8, 64})
             for lo in range(0, len(rows), 64):
                 chunk = rows[lo:lo + 64]
                 m = 8 if len(chunk) <= 8 else 64
-                # pad by repeating the first dirty row: duplicate scatter
-                # indexes write identical (correct) data -- harmless
                 idx = np.full(m, chunk[0], dtype=np.int32)
                 idx[:len(chunk)] = chunk
-                self._device = arena_scatter(
-                    *self._device, jnp.asarray(idx),
-                    jnp.asarray(self.keys_mod[idx]),
-                    jnp.asarray(self.ts[idx]), jnp.asarray(self.exec_ts[idx]),
-                    jnp.asarray(self.kinds[idx]), jnp.asarray(self.valid[idx]))
+                uploads = (idx, self.starts[idx], self.ends[idx],
+                           self.ts[idx], self.kinds[idx], self.valid[idx])
+                self.upload_bytes += sum(a.nbytes for a in uploads)
+                self._device = range_scatter(
+                    *self._device, *(jnp.asarray(a) for a in uploads))
             self._dirty_rows.clear()
         return self._device
 
@@ -525,33 +906,65 @@ class _Item:
     """One queued resolution (a PreAccept's deps or a standalone deps query)."""
 
     __slots__ = ("store", "txn_id", "owned", "before", "out", "outcome",
-                 "chunks", "cover_seq")
+                 "cover_seq", "fallback")
 
     def __init__(self, store, txn_id, owned, before, out, outcome=None):
         self.store = store
         self.txn_id = txn_id
-        self.owned = owned          # Keys (the store's slice of the subject)
+        self.owned = owned          # Keys or Ranges (the store's slice)
         self.before = before
         self.out = out              # AsyncResult
         self.outcome = outcome      # preaccept outcome (None for deps query)
-        self.chunks: List[int] = []  # subject-row indices in the dispatch
         # set at encode time: covers younger than this were invisible to the
         # kernel snapshot, so the decode must not elide by them (the covering
         # write would be missing from the reply)
         self.cover_seq = 0
+        # encode-time demotion (unencodable endpoints only): "full" answers
+        # the whole item host-side, "range" answers just the range-dep
+        # portion of a key subject host-side
+        self.fallback: Optional[str] = None
 
 
 class _Call:
-    __slots__ = ("packed", "items", "arena", "gen", "np_packed")
+    """One in-flight kernel dispatch: up to three device result buffers
+    (key-domain deps, range-arena candidates, key-arena candidates for range
+    subjects) plus the generation pins needed to decode them after a
+    compaction."""
 
-    def __init__(self, packed, items, arena):
-        self.packed = packed
+    __slots__ = ("packed", "rpacked", "kpacked", "items", "arena",
+                 "gen", "rgen", "np_packed", "np_rpacked", "np_kpacked")
+
+    def __init__(self, packed, rpacked, kpacked, items, arena):
+        self.packed = packed        # deps_resolve result (or None)
+        self.rpacked = rpacked      # range_deps_resolve range-arena result
+        self.kpacked = kpacked      # range_deps_resolve key-arena result
         self.items = items
         self.arena = arena
         self.gen = arena.gen
-        # host copy of `packed`, filled by the poll prefetch once the device
-        # finishes (or by a blocking read at harvest when it hasn't)
+        self.rgen = arena.ranges.gen
+        # host copies, filled by the poll prefetch once the device finishes
+        # (or by a blocking read at harvest when it hasn't)
         self.np_packed: Optional[np.ndarray] = None
+        self.np_rpacked: Optional[np.ndarray] = None
+        self.np_kpacked: Optional[np.ndarray] = None
+
+    def buffers(self):
+        return (("np_packed", self.packed), ("np_rpacked", self.rpacked),
+                ("np_kpacked", self.kpacked))
+
+    @property
+    def has_device(self) -> bool:
+        return self.packed is not None or self.rpacked is not None
+
+    def fetch(self) -> bool:
+        """Blocking read of any result the poll didn't drain; True if it
+        actually had to read (the harvest stall case)."""
+        stalled = False
+        for attr, buf in self.buffers():
+            if buf is not None and getattr(self, attr) is None:
+                setattr(self, attr, np.asarray(buf))
+                stalled = True
+        return stalled
 
 
 class BatchDepsResolver(DepsResolver):
@@ -583,15 +996,33 @@ class BatchDepsResolver(DepsResolver):
         self.harvest_stall_s = 0.0   # blocking on the async transfer
         self.decode_s = 0.0          # host-side result materialization
         self.prefetched = 0          # harvests whose transfer the poll drained
+        self.polls_armed = 0         # readiness polls armed (device_poll_ms)
         self.stale_harvests = 0      # calls translated across a compaction
         self.host_fallbacks = 0      # stale calls with no pinned snapshot
+        # residual counter for the RETIRED > MAXK host_only path: the CSR
+        # encoding keeps arbitrarily wide rows on device, so this must stay
+        # 0. Kept (asserted zero in bench/tests) for one release, then drop
+        self.host_only = 0
+        # subjects demoted host-side for unencodable range endpoints (never
+        # hit by integer key domains)
+        self.range_fallbacks = 0
+        # initial _RangeArena capacity (the sharded resolver widens it to
+        # keep rcap % (32*data) == 0)
+        self.range_cap = 64
+
+    @property
+    def upload_bytes(self) -> int:
+        """Total bytes shipped host->device by arena dirty-row scatters."""
+        return sum(a.upload_bytes + a.ranges.upload_bytes
+                   for a in self._arenas.values())
 
     # -- arena plumbing -------------------------------------------------------
     def _arena(self, store) -> _NodeArena:
         node = store.node
         arena = self._arenas.get(id(node))
         if arena is None:
-            arena = _NodeArena(self.num_buckets, self.initial_cap)
+            arena = _NodeArena(self.num_buckets, self.initial_cap,
+                               self.range_cap)
             self._arenas[id(node)] = arena
         if id(store) not in self._adopted:
             self._adopted.add(id(store))
@@ -600,25 +1031,32 @@ class BatchDepsResolver(DepsResolver):
                 for t, info in cfk._infos.items():
                     arena.update(t, (key,), info.status,
                                  info.execute_at or t.as_timestamp())
+            for t, rngs in store.range_txns.items():
+                # invalidated range txns were already popped from the map
+                arena.ranges.update(t, rngs, CfkStatus.WITNESSED)
         return arena
 
     # -- observer hooks (store.register funnel) -------------------------------
     def on_register(self, store, txn_id: TxnId, keys, status: CfkStatus,
                     witnessed_at: Timestamp) -> None:
-        if not isinstance(keys, Keys):
-            return  # range-domain txns stay host-side
-        self._arena(store).update(txn_id, set(keys), status, witnessed_at)
+        arena = self._arena(store)
+        if isinstance(keys, Keys):
+            arena.update(txn_id, set(keys), status, witnessed_at)
+        else:
+            # range-domain txns land in the interval arena (MaxConflicts for
+            # ranges stays on the host map, which the store merges itself)
+            arena.ranges.update(txn_id, keys, status)
 
     def on_truncate(self, store, txn_id: TxnId) -> None:
         arena = self._arenas.get(id(store.node))
         if arena is None:
             return
         row = arena.row_of.get(txn_id)
-        if row is None:
-            return
-        mine = {k for k in arena.key_sets[row]
-                if store.slice_ranges.contains_key(k)}
-        arena.remove_keys(txn_id, mine)
+        if row is not None:
+            mine = {k for k in arena.key_sets[row]
+                    if store.slice_ranges.contains_key(k)}
+            arena.remove_keys(txn_id, mine)
+        arena.ranges.truncate(store, txn_id)
 
     def on_prune(self, store, txn_id: TxnId, keys) -> None:
         arena = self._arenas.get(id(store.node))
@@ -680,93 +1118,116 @@ class BatchDepsResolver(DepsResolver):
             self._dispatch(node, items[lo:lo + self.max_dispatch])
 
     def _encode_and_run(self, arena: _NodeArena, items: List[_Item]):
-        """Chunk subjects, build the compact upload arrays, run the fused
-        kernel. Shared by the async dispatch and the sync path -- the two
-        must never drift. Returns the (device) packed result array.
+        """Build the flat CSR upload arrays and run the fused kernels.
+        Shared by the async dispatch and the sync path -- the two must never
+        drift. Returns (packed, rpacked, kpacked) device arrays (each may be
+        None when that kernel had nothing to do).
 
-        Fully vectorized: one flat key gather, one modular reduction and one
-        fancy-index scatter build every subject row (how an item's keys split
-        across its MAXK-wide chunks is semantically arbitrary -- the chunks
-        are OR-ed back together at decode, and the device one-hot tolerates
-        duplicate bucket indices -- so no per-chunk sort/dedup is needed)."""
+        Key-domain subjects upload one (subject row, key bucket) CSR entry
+        per owned key -- variable width, so arbitrarily wide subjects stay on
+        the device path (the old MAXK chunking and its host_only residual are
+        retired). When range state is in play, a second CSR of half-open
+        intervals drives range_deps_resolve: key subjects as point intervals
+        (stabbing the range arena), range subjects as their owned ranges
+        (vs both arenas)."""
         import jax.numpy as jnp
-        from accord_tpu.ops.kernels import subject_tier
-        MAXK = _NodeArena.MAXK
+        from accord_tpu.ops.kernels import nnz_tier, subject_tier
+        ranges = arena.ranges
         n = len(items)
-        counts = np.empty(n, np.int64)
+        b = subject_tier(n)
+        sb = np.zeros((b, 3), dtype=np.int32)
+        sb[:n] = arena.encoder.encode_many([item.before for item in items])
+        sknd = np.zeros(b, dtype=np.int32)
+        sknd[:n] = np.fromiter((int(item.txn_id.kind) for item in items),
+                               np.int64, n)
+        srng = np.zeros(b, dtype=bool)
+        key_items: List[Tuple[int, _Item]] = []
+        intervals: List[Tuple[int, int, int]] = []  # (subject, start, end)
+        need_range = False
         for i, item in enumerate(items):
             item.cover_seq = item.store.cover_seq
-            counts[i] = len(item.owned)
-        total = int(counts.sum())
-        nchunks = np.maximum(-(-counts // MAXK), 1)
-        chunk_base = np.concatenate(([0], np.cumsum(nchunks)))
-        total_chunks = int(chunk_base[-1])
-        for i, item in enumerate(items):
-            item.chunks = list(range(chunk_base[i], chunk_base[i + 1]))
-        padded = subject_tier(total_chunks)
-        sk = np.full((padded, MAXK), -1, dtype=np.int32)
-        if total:
-            mods = (np.fromiter(
-                (int(k) for item in items for k in item.owned),
-                np.int64, total) % self.num_buckets).astype(np.int32)
-            item_of_key = np.repeat(np.arange(n), counts)
-            pos = np.arange(total) - np.repeat(np.cumsum(counts) - counts,
-                                               counts)
-            sk[chunk_base[item_of_key] + pos // MAXK, pos % MAXK] = mods
-        sb = np.zeros((padded, 3), dtype=np.int32)
-        sb[:total_chunks] = np.repeat(
-            arena.encoder.encode_many([item.before for item in items]),
-            nchunks, axis=0)
-        sknd = np.zeros(padded, dtype=np.int32)
-        sknd[:total_chunks] = np.repeat(
-            np.fromiter((int(item.txn_id.kind) for item in items),
-                        np.int64, n), nchunks)
-        return self._run_kernel(arena, jnp.asarray(sk), jnp.asarray(sb),
-                                jnp.asarray(sknd))
+            if isinstance(item.owned, Keys):
+                key_items.append((i, item))
+                continue
+            srng[i] = True
+            if not ranges.encode_ok:
+                item.fallback = "full"
+                self.range_fallbacks += 1
+                continue
+            ivs = encode_seekable_intervals(item.owned)
+            if ivs is None:
+                item.fallback = "full"
+                self.range_fallbacks += 1
+                continue
+            need_range = True
+            intervals.extend((i, s, e) for (s, e) in ivs)
+        packed = None
+        if arena.count > 0 and key_items:
+            counts = np.fromiter((len(item.owned) for _, item in key_items),
+                                 np.int64, len(key_items))
+            total = int(counts.sum())
+            z = nnz_tier(total)
+            # CSR padding entries use subject row == b: out of bounds,
+            # dropped by the device scatter
+            subj_of = np.full(z, b, dtype=np.int32)
+            subj_keys = np.zeros(z, dtype=np.int32)
+            if total:
+                subj_of[:total] = np.repeat(
+                    np.fromiter((i for i, _ in key_items), np.int64,
+                                len(key_items)), counts)
+                subj_keys[:total] = (np.fromiter(
+                    (int(k) for _, item in key_items for k in item.owned),
+                    np.int64, total) % self.num_buckets).astype(np.int32)
+            packed = self._run_kernel(
+                arena, jnp.asarray(subj_of), jnp.asarray(subj_keys),
+                jnp.asarray(sb), jnp.asarray(sknd))
+        if ranges.encode_ok and ranges.count > 0:
+            # key subjects stab the interval rows with point intervals (the
+            # retired host_range_deps union, on device)
+            for i, item in key_items:
+                ivs = encode_seekable_intervals(item.owned)
+                if ivs is None:
+                    # unencodable keys: this subject's range deps come from
+                    # the host union instead (counted)
+                    item.fallback = "range"
+                    self.range_fallbacks += 1
+                    continue
+                need_range = True
+                intervals.extend((i, s, e) for (s, e) in ivs)
+        rpacked = kpacked = None
+        if need_range and intervals:
+            nv = nnz_tier(len(intervals))
+            iv_of = np.full(nv, b, dtype=np.int32)
+            iv_s = np.zeros(nv, dtype=np.int32)
+            iv_e = np.zeros(nv, dtype=np.int32)
+            arr = np.asarray(intervals, dtype=np.int64)
+            iv_of[:len(intervals)] = arr[:, 0]
+            iv_s[:len(intervals)] = arr[:, 1]
+            iv_e[:len(intervals)] = arr[:, 2]
+            rpacked, kpacked = self._run_range_kernel(
+                arena, jnp.asarray(iv_of), jnp.asarray(iv_s),
+                jnp.asarray(iv_e), jnp.asarray(sb), jnp.asarray(sknd),
+                jnp.asarray(srng))
+        return packed, rpacked, kpacked
 
-    def _run_kernel(self, arena: "_NodeArena", sk, sb, sknd):
+    def _run_kernel(self, arena: "_NodeArena", subj_of, subj_keys, sb, sknd):
         """The fused kernel call; ShardedBatchDepsResolver overrides this to
         run the same computation sharded over a device mesh."""
         from accord_tpu.ops.kernels import deps_resolve
-        act_bm, act_ts, _, act_kinds, act_valid = arena.device_arrays()
-        return deps_resolve(sk, sb, sknd,
+        act_bm, act_ts, _, act_kinds, _, _, act_valid = arena.device_arrays()
+        return deps_resolve(subj_of, subj_keys, sb, sknd,
                             act_bm, act_ts, act_kinds, act_valid, self._table)
 
-    def _host_only_prep(self, arena: _NodeArena):
-        """Precompute the host_only residual scan's inputs once per harvest:
-        (live wide rows, union of their keys) -- or None, letting every item
-        skip the supplement with one set lookup."""
-        if not arena.host_only:
-            return None
-        rows = [j for j in arena.host_only if j not in arena.invalidated]
-        if not rows:
-            return None
-        keys: set = set()
-        for j in rows:
-            keys |= arena.key_sets[j]
-        return rows, keys
-
-    def _host_only_residual(self, arena: _NodeArena, item: _Item, kd, ho):
-        """Rows too wide for the device (> MAXK keys) are scanned host-side
-        and unioned into the device result (rare)."""
-        rows, ho_keys = ho
-        subj_set = set(item.owned)
-        if ho_keys.isdisjoint(subj_set):
-            return kd
-        kb = KeyDepsBuilder()
-        cfks = item.store.cfks
-        for j in rows:
-            dep_id = arena.txn_ids[j]
-            if dep_id != item.txn_id and dep_id < item.before \
-                    and item.txn_id.kind.witnesses(dep_id.kind):
-                for k in arena.key_sets[j] & subj_set:
-                    c = cfks.get(k)
-                    e = c.covered.get(dep_id) if c is not None else None
-                    if e is not None and e[0] <= item.cover_seq \
-                            and e[1] < item.before:
-                        continue  # transitive-dependency elision (cfk rule)
-                    kb.add(k, dep_id)
-        return kd.union(kb.build())
+    def _run_range_kernel(self, arena: "_NodeArena", iv_of, iv_s, iv_e,
+                          sb, sknd, srng):
+        from accord_tpu.ops.kernels import range_deps_resolve
+        r_start, r_end, r_ts, r_kinds, r_valid = \
+            arena.ranges.device_arrays()
+        _, k_ts, _, k_kinds, k_kmin, k_kmax, k_valid = arena.device_arrays()
+        return range_deps_resolve(iv_of, iv_s, iv_e, sb, sknd, srng,
+                                  r_start, r_end, r_ts, r_kinds, r_valid,
+                                  k_kmin, k_kmax, k_ts, k_kinds, k_valid,
+                                  self._table)
 
     def _decode_batch(self, arena: _NodeArena, items: List[_Item],
                       packed: np.ndarray) -> list:
@@ -774,21 +1235,19 @@ class BatchDepsResolver(DepsResolver):
         bit-packed kernel result in one vectorized pass -> [KeyDeps].
 
         Replaces the per-item decode loop (whose per-subject numpy-call
-        overhead dominated harvest at large dispatch sizes): one reduceat
-        OR-combines each item's chunks, one unpackbits yields all candidate
-        (item, dep row) pairs, a stacked key-bitmask gather tests exact key
-        membership for every (candidate, key slot) pair at once, and a single
-        global sort by (key slot, timestamp rank) puts every item's CSR in
-        final order. Per-item work is reduced to slicing its segment."""
+        overhead dominated harvest at large dispatch sizes): one unpackbits
+        yields all candidate (item, dep row) pairs, a stacked key-bitmask
+        gather tests exact key membership for every (candidate, key slot)
+        pair at once, and a single global sort by (key slot, timestamp rank)
+        puts every item's CSR in final order. Per-item work is reduced to
+        slicing its segment. Range-domain items pass through with EMPTY here
+        (their deps decode from the range kernel's buffers instead)."""
         from accord_tpu.primitives.deps import KeyDeps
         n = len(items)
         out = [KeyDeps.EMPTY] * n
-        # 1. OR each item's chunk rows together (chunks are consecutive)
-        starts = np.fromiter((item.chunks[0] for item in items), np.int64, n)
-        end = items[-1].chunks[-1] + 1
-        item_packed = np.bitwise_or.reduceat(
-            np.ascontiguousarray(packed[:end]).astype("<u4", copy=False),
-            starts, axis=0)
+        # 1. subject rows are 1:1 with items under the CSR encoding (copy:
+        #    the self-bit clear below must not mutate the harvested buffer)
+        item_packed = packed[:n].astype("<u4", copy=True)
         # 2. clear each subject's own row bit (self is never a dep)
         srows = np.fromiter((arena.row_of.get(item.txn_id, -1)
                              for item in items), np.int64, n)
@@ -813,6 +1272,8 @@ class BatchDepsResolver(DepsResolver):
         key_cnt = np.zeros(n, np.int64)
         covered_any = False
         for i, item in enumerate(items):
+            if not isinstance(item.owned, Keys):
+                continue            # range subject: no key slots here
             cfks = item.store.cfks
             cnt = 0
             for k in item.owned:    # Keys iterates sorted unique
@@ -902,86 +1363,187 @@ class BatchDepsResolver(DepsResolver):
                              tuple(inv.tolist()))
         return out
 
-    def _decode_dispatch(self, call: _Call) -> List[Deps]:
-        """Decode a harvested call against the (matching-generation) arena:
-        batched device decode + host_only residual + range union + floor."""
+    def _decode_key_range_deps(self, arena: _NodeArena, call: _Call,
+                               i: int, item: _Item):
+        """Range-txn deps of a KEY subject, recovered from the range
+        kernel's candidate rows -- the device replacement for the retired
+        host_range_deps union. Exact: per-key containment against the
+        store's CURRENT range_txns filters interval false positives
+        (cross-store rows, freed-row reuse, retired generations), and the
+        before/witness masks are re-verified host-side. None when a stale
+        call has no pinned snapshot (caller falls back; counted)."""
+        rows = _unpack_row(call.np_rpacked[i])
+        cand = arena.ranges.candidate_ids(call.rgen, rows)
+        if cand is None:
+            return None
+        kb = KeyDepsBuilder()
+        store = item.store
+        kind = item.txn_id.kind
+        rt = store.range_txns
+        for rid in cand:
+            if rid == item.txn_id or rid not in rt:
+                continue
+            if not (rid < item.before and kind.witnesses(rid.kind)):
+                continue
+            rngs = rt[rid]
+            for k in item.owned:
+                if rngs.contains_key(k):
+                    kb.add(k, rid)
+        return kb.build()
+
+    def _decode_range_subject(self, arena: _NodeArena, call: _Call,
+                              i: int, item: _Item) -> Optional[Deps]:
+        """A RANGE subject's full Deps from the two candidate buffers:
+        range-vs-range from the interval arena (re-sliced per store against
+        range_txns), range-vs-key from the key arena's span hull (re-filtered
+        per real key, with the host scan's covered-elision and invalidation
+        rules). None -> no usable snapshot (caller falls back; counted)."""
+        from accord_tpu.primitives.deps import KeyDeps
+        store = item.store
+        kind = item.txn_id.kind
+        rb = RangeDepsBuilder()
+        if call.np_rpacked is not None:
+            rows = _unpack_row(call.np_rpacked[i])
+            cand = arena.ranges.candidate_ids(call.rgen, rows)
+            if cand is None:
+                return None
+            rt = store.range_txns
+            for rid in cand:
+                if rid == item.txn_id or rid not in rt:
+                    continue
+                if not (rid < item.before and kind.witnesses(rid.kind)):
+                    continue
+                for r in rt[rid].intersection(item.owned):
+                    rb.add(r, rid)
+        if call.np_kpacked is not None:
+            krows = _unpack_row(call.np_kpacked[i])
+            if call.gen != arena.gen:
+                krows = arena.translate_rows(call.gen, krows)
+                if krows is None:
+                    return None
+            cfks = store.cfks
+            for j in krows:
+                dep_id = arena.ids_np[j]
+                if dep_id is None or dep_id == item.txn_id:
+                    continue
+                if not (dep_id < item.before
+                        and kind.witnesses(dep_id.kind)):
+                    continue
+                for k in arena.key_sets[j]:
+                    if not item.owned.contains_key(k):
+                        continue  # span-hull false positive / other store
+                    c = cfks.get(k)
+                    if c is None:
+                        continue
+                    info = c.get(dep_id)
+                    if info is None or info.status == CfkStatus.INVALIDATED:
+                        continue
+                    e = c.covered.get(dep_id) if c.covered else None
+                    if e is not None and e[0] <= item.cover_seq \
+                            and e[1] < item.before:
+                        continue  # transitive-dependency elision (cfk rule)
+                    rb.add(Range.point(k), dep_id)
+        return Deps(KeyDeps.EMPTY, rb.build())
+
+    def _decode_core(self, call: _Call) -> List[Deps]:
+        """Decode a harvested call -> raw Deps per item (no floor injection
+        -- sync callers' floors are injected by store.calculate_deps; the
+        async harvest wraps this with _decode_dispatch). Handles same-gen
+        and stale (compacted mid-flight) calls uniformly: key-domain rows
+        translate through the pinned row snapshot, range candidates
+        translate by txn id. Falls back to the host scan only when no
+        snapshot survived (counted; not expected)."""
         from accord_tpu.primitives.deps import KeyDeps
         arena = call.arena
-        if call.np_packed is None:
-            kds = [KeyDeps.EMPTY] * len(call.items)
-        else:
-            kds = self._decode_batch(arena, call.items, call.np_packed)
-        ho = self._host_only_prep(arena)
-        results = []
-        for item, kd in zip(call.items, kds):
+        items = call.items
+        key_stale = call.np_packed is not None and call.gen != arena.gen
+        kds = None
+        if call.np_packed is not None and not key_stale:
+            kds = self._decode_batch(arena, items, call.np_packed)
+        results: List[Deps] = []
+        for i, item in enumerate(items):
             store = item.store
-            if ho is not None:
-                kd = self._host_only_residual(arena, item, kd, ho)
-            deps = Deps(kd)
-            if store.range_txns:
-                deps = deps.union(store.host_range_deps(
+            if item.fallback == "full":
+                results.append(store.host_calculate_deps(
                     item.txn_id, item.owned, item.before))
-            results.append(store.inject_dep_floor(item.txn_id, item.owned,
-                                                  deps, item.before))
+                continue
+            if not isinstance(item.owned, Keys):
+                if not arena.ranges.encode_ok:
+                    # reached only via the empty-call path (encode sets
+                    # fallback="full" otherwise): unencodable node state
+                    self.range_fallbacks += 1
+                    results.append(store.host_calculate_deps(
+                        item.txn_id, item.owned, item.before))
+                    continue
+                d = self._decode_range_subject(arena, call, i, item)
+                if d is None:
+                    self.host_fallbacks += 1
+                    d = store.host_calculate_deps(item.txn_id, item.owned,
+                                                  item.before)
+                results.append(d)
+                continue
+            if kds is not None:
+                kd = kds[i]
+            elif key_stale:
+                rows = arena.translate_rows(
+                    call.gen, _unpack_row(call.np_packed[i]))
+                if rows is None:
+                    self.host_fallbacks += 1
+                    results.append(store.host_calculate_deps(
+                        item.txn_id, item.owned, item.before))
+                    continue
+                kd = arena.decode_rows(item.txn_id, item.owned, rows,
+                                       store, item.before, item.cover_seq)
+            else:
+                kd = KeyDeps.EMPTY
+            deps = Deps(kd)
+            if item.fallback == "range" or not arena.ranges.encode_ok:
+                if store.range_txns:
+                    deps = deps.union(store.host_range_deps(
+                        item.txn_id, item.owned, item.before))
+            elif call.np_rpacked is not None:
+                extra = self._decode_key_range_deps(arena, call, i, item)
+                if extra is None:
+                    self.host_fallbacks += 1
+                    deps = deps.union(store.host_range_deps(
+                        item.txn_id, item.owned, item.before))
+                elif not extra.is_empty():
+                    deps = deps.union(Deps(extra))
+            results.append(deps)
         return results
 
-    def _decode_stale(self, call: _Call) -> List[Deps]:
-        """The arena compacted while this call was in flight: its packed
-        rows address the RETIRED row mapping. Translate them (old row -> txn
-        id -> current row, via the snapshot compact() pinned) and decode
-        against current state -- identical semantics to the normal path,
-        which also decodes against post-dispatch state. Falls back to the
-        host scan only if no snapshot exists (counted; not expected)."""
-        arena = call.arena
-        packed = call.np_packed
-        ho = self._host_only_prep(arena)
-        results = []
-        for item in call.items:
-            store = item.store
-            rows = None
-            if packed is not None:
-                prow = packed[item.chunks[0]]
-                for c in item.chunks[1:]:
-                    prow = prow | packed[c]
-                wnz = np.nonzero(prow)[0]
-                sub = np.unpackbits(prow[wnz].astype("<u4").view(np.uint8),
-                                    bitorder="little").reshape(wnz.size, 32)
-                rr, cc = np.nonzero(sub)
-                old_rows = (wnz[rr].astype(np.int64) << 5) | cc
-                rows = arena.translate_rows(call.gen, old_rows)
-            if rows is None:
-                self.host_fallbacks += 1
-                raw = store.host_calculate_deps(item.txn_id, item.owned,
-                                                item.before)
-                results.append(store.inject_dep_floor(
-                    item.txn_id, item.owned, raw, item.before))
-                continue
-            kd = arena.decode_rows(item.txn_id, item.owned, rows,
-                                   store, item.before, item.cover_seq)
-            if ho is not None:
-                kd = self._host_only_residual(arena, item, kd, ho)
-            deps = Deps(kd)
-            if store.range_txns:
-                deps = deps.union(store.host_range_deps(
-                    item.txn_id, item.owned, item.before))
-            results.append(store.inject_dep_floor(item.txn_id, item.owned,
-                                                  deps, item.before))
-        return results
+    def _decode_dispatch(self, call: _Call) -> List[Deps]:
+        """The async harvest decode: core recovery + the store's dep floor
+        (the sync path's floors come from store.calculate_deps instead)."""
+        return [item.store.inject_dep_floor(item.txn_id, item.owned, d,
+                                            item.before)
+                for item, d in zip(call.items, self._decode_core(call))]
 
     def _dispatch(self, node, items: List[_Item]) -> None:
         import time as _time
         for item in items:
             self._arena(item.store)  # ensure adoption of late-attached stores
         arena = self._arenas.get(id(node))
-        if arena is None or arena.count == 0:
-            call = _Call(None, items, arena or _NodeArena(self.num_buckets, 8))
+        if arena is None or (arena.count == 0 and arena.ranges.count == 0):
+            # nothing on device to conflict with (and possibly no encoder
+            # yet): an empty call still flows through the pipeline so floors
+            # and fallbacks are injected at harvest
+            call = _Call(None, None, None, items,
+                         arena or _NodeArena(self.num_buckets, 8))
         else:
             t0 = _time.perf_counter()
-            packed = self._encode_and_run(arena, items)
-            packed.copy_to_host_async()
+            packed, rpacked, kpacked = self._encode_and_run(arena, items)
+            for buf in (packed, rpacked, kpacked):
+                if buf is not None:
+                    buf.copy_to_host_async()
             self.encode_s += _time.perf_counter() - t0
-            call = _Call(packed, items, arena)
-            arena.pin_gen()  # matched by unpin_gen in _harvest
+            call = _Call(packed, rpacked, kpacked, items, arena)
+            # matched by unpin_gen in _harvest; kpacked rows address the KEY
+            # arena, so either key-domain buffer pins the key snapshot
+            if packed is not None or kpacked is not None:
+                arena.pin_gen()
+            if rpacked is not None:
+                arena.ranges.pin_gen()
         self.dispatches += 1
         self.subjects += len(items)
         self._inflight.setdefault(id(node), deque()).append(call)
@@ -1005,15 +1567,21 @@ class BatchDepsResolver(DepsResolver):
         if poll is None or interval is None or id(node) in self._polling:
             return
         self._polling.add(id(node))
+        self.polls_armed += 1
         q = self._inflight[id(node)]
 
         def prefetch() -> bool:
             for call in q:
-                if call.packed is None or call.np_packed is not None:
-                    continue
-                if not call.packed.is_ready():
+                done = True
+                for attr, buf in call.buffers():
+                    if buf is None or getattr(call, attr) is not None:
+                        continue
+                    if not buf.is_ready():
+                        done = False
+                        break
+                    setattr(call, attr, np.asarray(buf))
+                if not done:
                     break  # single device stream: later calls finish later
-                call.np_packed = np.asarray(call.packed)
             if q:
                 return True
             self._polling.discard(id(node))
@@ -1028,21 +1596,22 @@ class BatchDepsResolver(DepsResolver):
             return  # defensive: every dispatch schedules exactly one harvest
         call = q.popleft()
         arena = call.arena
-        if call.packed is not None:
-            if call.np_packed is not None:
-                self.prefetched += 1
-            else:
-                t0 = _time.perf_counter()
-                call.np_packed = np.asarray(call.packed)
+        if call.has_device:
+            t0 = _time.perf_counter()
+            if call.fetch():
                 self.harvest_stall_s += _time.perf_counter() - t0
+            else:
+                self.prefetched += 1
         t0 = _time.perf_counter()
-        if call.packed is not None and call.gen != arena.gen:
+        if (call.packed is not None and call.gen != arena.gen) \
+                or (call.rpacked is not None
+                    and call.rgen != arena.ranges.gen):
             self.stale_harvests += 1
-            results = self._decode_stale(call)
-        else:
-            results = self._decode_dispatch(call)
-        if call.packed is not None:
+        results = self._decode_dispatch(call)
+        if call.packed is not None or call.kpacked is not None:
             arena.unpin_gen(call.gen)
+        if call.rpacked is not None:
+            arena.ranges.unpin_gen(call.rgen)
         self.decode_s += _time.perf_counter() - t0
         for item, deps in zip(call.items, results):
             if item.outcome is not None:
@@ -1052,9 +1621,6 @@ class BatchDepsResolver(DepsResolver):
 
     # -- synchronous SPI (tests, rare recovery-path callers) ------------------
     def resolve_one(self, store, txn_id, seekables, before) -> Deps:
-        if not isinstance(seekables, Keys):
-            # range-domain subjects stay on the host path for now
-            return store.host_calculate_deps(txn_id, seekables, before)
         arena = self._arenas.get(id(store.node))
         if arena is not None and arena.encoder is not None \
                 and not arena.encoder.in_window(before):
@@ -1062,29 +1628,24 @@ class BatchDepsResolver(DepsResolver):
             # unencodable on device -- the host scan answers
             return store.host_calculate_deps(txn_id, seekables, before)
         owned = store.owned(seekables)
-        deps = self.resolve_batch(store, [(txn_id, owned, before)])[0]
-        if store.range_txns:
-            # range txns are tracked host-side; union ONLY those in (the
-            # device result already has the key-domain deps exactly)
-            deps = deps.union(store.host_range_deps(txn_id, owned, before))
-        return deps
+        return self.resolve_batch(store, [(txn_id, owned, before)])[0]
 
     def resolve_batch(self, store,
-                      subjects: Sequence[Tuple[TxnId, Keys, Timestamp]]) -> List[Deps]:
+                      subjects: Sequence[Tuple[TxnId, Seekables, Timestamp]]) -> List[Deps]:
         """Synchronous resolve (dispatch + immediate harvest): exact host
-        parity, used by differential tests and the rare non-batched callers."""
+        parity for BOTH key- and range-domain subjects, used by differential
+        tests and the rare non-batched callers. No floor injection here --
+        store.calculate_deps owns the floor on this path."""
         arena = self._arena(store)
-        if arena.count == 0:
-            return [Deps.NONE for _ in subjects]
         items = [_Item(store, t, owned, before, None)
                  for (t, owned, before) in subjects]
-        packed = np.asarray(self._encode_and_run(arena, items))
-        kds = self._decode_batch(arena, items, packed)
-        ho = self._host_only_prep(arena)
-        if ho is not None:
-            kds = [self._host_only_residual(arena, item, kd, ho)
-                   for item, kd in zip(items, kds)]
-        return [Deps(kd) for kd in kds]
+        if arena.count == 0 and arena.ranges.count == 0:
+            call = _Call(None, None, None, items, arena)
+        else:
+            packed, rpacked, kpacked = self._encode_and_run(arena, items)
+            call = _Call(packed, rpacked, kpacked, items, arena)
+            call.fetch()
+        return self._decode_core(call)
 
     # -- max-conflict (device path; inline mode + bench only) ----------------
     def max_conflict(self, store, txn_id: TxnId,
@@ -1097,10 +1658,11 @@ class BatchDepsResolver(DepsResolver):
             # here would serialize the pipeline on the tunnel round trip
             return False, None
         arena = self._arenas.get(id(store.node))
-        if arena is not None and (arena.had_truncation or arena.host_only):
-            # truncation shrinks bitmap rows and host_only rows (> MAXK keys)
-            # have no device bitmap at all: either way the (monotone) device
-            # max-conflict could understate -- the host decides
+        if arena is not None and arena.had_truncation:
+            # truncation shrinks bitmap rows, so the (monotone) device
+            # max-conflict could understate -- the host decides. (The old
+            # host_only guard is gone: the CSR encoding keeps wide rows on
+            # device.)
             return False, None
         res = self.max_conflict_batch(store, [(txn_id, seekables)])
         return res[0]
@@ -1120,7 +1682,7 @@ class BatchDepsResolver(DepsResolver):
         padded_b = bucket_size(b)
         bitmaps = encode_key_bitmaps([tuple(kk) for _, kk in subjects],
                                      self.num_buckets)
-        act_bm, _, act_exec, _, act_valid = arena.device_arrays()
+        act_bm, _, act_exec, _, _, _, act_valid = arena.device_arrays()
         # registered rows count even when invalidated (MaxConflicts is
         # monotone in the reference); valid lane is NOT applied here
         all_rows = jnp.ones_like(act_valid)
@@ -1171,12 +1733,26 @@ class ShardedBatchDepsResolver(BatchDepsResolver):
         Invariants.check_argument(
             num_buckets % model == 0,
             "num_buckets %s not divisible by model(%s)", num_buckets, model)
+        # the range arena shards its rows over 'data' too, so its capacity
+        # must honor the same 32*data packing contract (GROW=2 preserves it)
+        self.range_cap = max(64, 32 * data)
 
-    def _run_kernel(self, arena: _NodeArena, sk, sb, sknd):
+    def _run_kernel(self, arena: _NodeArena, subj_of, subj_keys, sb, sknd):
         # sharded_deps_resolve is lru_cached by mesh: every resolver (one
         # per node in a burn) shares one compiled kernel
         from accord_tpu.parallel.mesh import sharded_deps_resolve
         kern = sharded_deps_resolve(self.mesh)
-        act_bm, act_ts, _, act_kinds, act_valid = arena.device_arrays()
-        return kern(sk, sb, sknd,
+        act_bm, act_ts, _, act_kinds, _, _, act_valid = arena.device_arrays()
+        return kern(subj_of, subj_keys, sb, sknd,
                     act_bm, act_ts, act_kinds, act_valid, self._table)
+
+    def _run_range_kernel(self, arena: _NodeArena, iv_of, iv_s, iv_e,
+                          sb, sknd, srng):
+        from accord_tpu.parallel.mesh import sharded_range_deps_resolve
+        kern = sharded_range_deps_resolve(self.mesh)
+        r_start, r_end, r_ts, r_kinds, r_valid = \
+            arena.ranges.device_arrays()
+        _, k_ts, _, k_kinds, k_kmin, k_kmax, k_valid = arena.device_arrays()
+        return kern(iv_of, iv_s, iv_e, sb, sknd, srng,
+                    r_start, r_end, r_ts, r_kinds, r_valid,
+                    k_kmin, k_kmax, k_ts, k_kinds, k_valid, self._table)

@@ -134,71 +134,151 @@ def sharded_deps_resolve(mesh: Mesh):
 
     Contracts (enforced by ShardedBatchDepsResolver): cap % (32 * data) == 0
     and num_buckets % model == 0 -- both preserved by arena doubling."""
-    from accord_tpu.ops.kernels import _lex_before
+    from accord_tpu.ops.kernels import _lex_before, _pack_bits
 
-    def run(subj_keys, subj_before, subj_kinds,
+    def run(subj_of, subj_keys, subj_before, subj_kinds,
             act_bm, act_ts, act_kinds, act_valid, table):
-        def part(sk, sb, sknd, bm, ts, kinds, valid, tbl):
-            # bm: [cap_local, K_local]; subject one-hot restricted to the
-            # LOCAL bucket slice so the contraction psums over 'model'
+        def part(sof, sk, sb, sknd, bm, ts, kinds, valid, tbl):
+            # bm: [cap_local, K_local]; the subject CSR scatter restricted
+            # to the LOCAL bucket slice so the contraction psums over
+            # 'model'. Out-of-slice entries remap to col == k_local (OOB,
+            # dropped); the guard also catches negative cols, which jax
+            # would otherwise WRAP into the slice.
+            b = sb.shape[0]
             k_local = bm.shape[1]
             base = jax.lax.axis_index("model") * k_local
-            local_buckets = base + jnp.arange(k_local, dtype=jnp.int32)
-            onehot = (sk[:, :, None] == local_buckets[None, None, :]) \
-                & (sk >= 0)[:, :, None]
-            subj_bm = onehot.any(axis=1).astype(jnp.bfloat16)
+            col = sk - base
+            col = jnp.where((col >= 0) & (col < k_local), col, k_local)
+            subj_bm = jnp.zeros((b, k_local), jnp.float32) \
+                .at[sof, col].max(1.0, mode="drop").astype(jnp.bfloat16)
             partial = jax.lax.dot_general(
                 subj_bm, bm.astype(jnp.bfloat16),
                 (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
             overlap = jax.lax.psum(partial, "model") > 0.5
             witness = tbl[sknd[:, None], kinds[None, :]] == 1
             before = _lex_before(ts[None, :, :], sb[:, None, :])
-            m = overlap & witness & before & valid[None, :]
-            b, a = m.shape
-            weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
-            return jnp.sum(m.reshape(b, a // 32, 32).astype(jnp.uint32)
-                           * weights[None, None, :], axis=-1, dtype=jnp.uint32)
+            return _pack_bits(overlap & witness & before & valid[None, :])
 
         return shard_map(
             part, mesh=mesh,
-            in_specs=(P(None, None), P(None, None), P(None),
+            in_specs=(P(None), P(None), P(None, None), P(None),
                       P("data", "model"), P("data", None), P("data"),
                       P("data"), P(None, None)),
             out_specs=P(None, "data"),
-        )(subj_keys, subj_before, subj_kinds,
+        )(subj_of, subj_keys, subj_before, subj_kinds,
           act_bm, act_ts, act_kinds, act_valid, table)
 
     rep2 = NamedSharding(mesh, P(None, None))
     rep1 = NamedSharding(mesh, P(None))
     return jax.jit(run, in_shardings=(
-        rep2, rep2, rep1,
+        rep1, rep1, rep2, rep1,
         NamedSharding(mesh, P("data", "model")),
         NamedSharding(mesh, P("data", None)),
         NamedSharding(mesh, P("data")), NamedSharding(mesh, P("data")),
         rep2), out_shardings=NamedSharding(mesh, P(None, "data")))
 
 
+@functools.lru_cache(maxsize=8)
+def sharded_range_deps_resolve(mesh: Mesh):
+    """Mesh-sharded twin of ops.kernels.range_deps_resolve: range-arena rows
+    AND key-arena rows shard over 'data' only (the interval compares have no
+    bucket dimension to contract, so 'model' lanes just replicate the tiny
+    subject CSR and each compute their data block). Both packed outputs come
+    back lane-sharded over 'data'; lane order equals row order because
+    rcap % (32 * data) == 0 and cap % (32 * data) == 0 (the resolver's
+    capacity contracts, preserved by doubling)."""
+    from accord_tpu.ops.kernels import _lex_before, _pack_bits
+
+    def run(iv_of, iv_start, iv_end, subj_before, subj_kinds, subj_is_range,
+            r_start, r_end, r_ts, r_kinds, r_valid,
+            k_kmin, k_kmax, k_ts, k_kinds, k_valid, table):
+        def part(ivo, ivs, ive, sb, sknd, srng,
+                 rs, re_, rts, rkd, rvl, kmn, kmx, kts, kknd, kvl, tbl):
+            b = sb.shape[0]
+            rcap_l = rs.shape[0]
+            cap_l = kmn.shape[0]
+            hit_r = (ivs[:, None] < re_[None, :]) & (rs[None, :] < ive[:, None])
+            any_r = jnp.zeros((b, rcap_l), jnp.int32) \
+                .at[ivo].max(hit_r.astype(jnp.int32), mode="drop") > 0
+            witness_r = tbl[sknd[:, None], rkd[None, :]] == 1
+            before_r = _lex_before(rts[None, :, :], sb[:, None, :])
+            m_r = any_r & witness_r & before_r & rvl[None, :]
+            hit_k = (ivs[:, None] <= kmx[None, :]) & (kmn[None, :] < ive[:, None])
+            any_k = jnp.zeros((b, cap_l), jnp.int32) \
+                .at[ivo].max(hit_k.astype(jnp.int32), mode="drop") > 0
+            witness_k = tbl[sknd[:, None], kknd[None, :]] == 1
+            before_k = _lex_before(kts[None, :, :], sb[:, None, :])
+            m_k = any_k & witness_k & before_k & kvl[None, :] & srng[:, None]
+            return _pack_bits(m_r), _pack_bits(m_k)
+
+        return shard_map(
+            part, mesh=mesh,
+            in_specs=(P(None), P(None), P(None), P(None, None), P(None),
+                      P(None),
+                      P("data"), P("data"), P("data", None), P("data"),
+                      P("data"),
+                      P("data"), P("data"), P("data", None), P("data"),
+                      P("data"), P(None, None)),
+            out_specs=(P(None, "data"), P(None, "data")),
+        )(iv_of, iv_start, iv_end, subj_before, subj_kinds, subj_is_range,
+          r_start, r_end, r_ts, r_kinds, r_valid,
+          k_kmin, k_kmax, k_ts, k_kinds, k_valid, table)
+
+    rep2 = NamedSharding(mesh, P(None, None))
+    rep1 = NamedSharding(mesh, P(None))
+    d1 = NamedSharding(mesh, P("data"))
+    d2 = NamedSharding(mesh, P("data", None))
+    out = NamedSharding(mesh, P(None, "data"))
+    return jax.jit(run, in_shardings=(
+        rep1, rep1, rep1, rep2, rep1, rep1,
+        d1, d1, d2, d1, d1,
+        d1, d1, d2, d1, d1, rep2), out_shardings=(out, out))
+
+
 def warmup_sharded(mesh: Mesh, num_buckets: int = 256, cap: int = 4096,
-                   batch_tiers: Tuple[int, ...] = (8, 64, 128)) -> None:
-    """Pre-compile the sharded hot kernel's subject-batch jit tiers (the
-    sharded twin of ops.resolver.warmup; same {8, 64, 128} padding ladder
-    the overlapped pipeline dispatches). One call covers every
-    ShardedBatchDepsResolver on the same mesh + (num_buckets, cap) --
-    sharded_deps_resolve is lru_cached by mesh and jit caches by shape."""
+                   batch_tiers: Tuple[int, ...] = (8, 64, 128),
+                   nnz_tiers: Optional[Tuple[int, ...]] = None,
+                   range_cap: Optional[int] = None) -> None:
+    """Pre-compile the sharded hot kernels' (batch tier, nnz tier) jit
+    cross product (the sharded twin of ops.resolver.warmup; same padding
+    ladders the overlapped pipeline dispatches). One call covers every
+    ShardedBatchDepsResolver on the same mesh + (num_buckets, cap,
+    range_cap) -- the kernel builders are lru_cached by mesh and jit caches
+    by shape."""
     from accord_tpu.ops.encoding import WITNESS_TABLE
-    from accord_tpu.ops.resolver import _NodeArena
+    from accord_tpu.ops.kernels import NNZ_TIERS
+    if nnz_tiers is None:
+        nnz_tiers = NNZ_TIERS
+    if range_cap is None:
+        range_cap = max(64, 32 * mesh.shape["data"])
     kern = sharded_deps_resolve(mesh)
-    maxk = _NodeArena.MAXK
+    rkern = sharded_range_deps_resolve(mesh)
+    neg = np.iinfo(np.int32).min
+    pos = np.iinfo(np.int32).max
     bm = jnp.zeros((cap, num_buckets), jnp.float32)
     ts = jnp.zeros((cap, 3), jnp.int32)
     kinds = jnp.zeros(cap, jnp.int32)
+    kmin = jnp.full(cap, pos, jnp.int32)
+    kmax = jnp.full(cap, neg, jnp.int32)
     valid = jnp.zeros(cap, bool)
+    rs = jnp.zeros(range_cap, jnp.int32)
+    re_ = jnp.zeros(range_cap, jnp.int32)
+    rts = jnp.zeros((range_cap, 3), jnp.int32)
+    rkd = jnp.zeros(range_cap, jnp.int32)
+    rvl = jnp.zeros(range_cap, bool)
     table = jnp.asarray(WITNESS_TABLE)
     out = None
     for b in batch_tiers:
-        out = kern(jnp.full((b, maxk), -1, jnp.int32),
-                   jnp.zeros((b, 3), jnp.int32), jnp.zeros(b, jnp.int32),
-                   bm, ts, kinds, valid, table)
+        sb = jnp.zeros((b, 3), jnp.int32)
+        sknd = jnp.zeros(b, jnp.int32)
+        srng = jnp.zeros(b, bool)
+        for z in nnz_tiers:
+            of = jnp.full(z, b, jnp.int32)
+            zz = jnp.zeros(z, jnp.int32)
+            out = kern(of, zz, sb, sknd, bm, ts, kinds, valid, table)
+            out = rkern(of, zz, zz, sb, sknd, srng,
+                        rs, re_, rts, rkd, rvl,
+                        kmin, kmax, ts, kinds, valid, table)
     if out is not None:
         jax.block_until_ready(out)
 
@@ -216,15 +296,17 @@ def example_batch(n: int = 64, k: int = 256, seed: int = 0):
 
 
 def example_resolve_batch(cap: int = 512, k: int = 256, b: int = 16,
-                          maxk: int = 16, seed: int = 0):
+                          nnz: int = 64, seed: int = 0):
     """Deterministic random inputs in deps_resolve's exact signature shape
-    (subjects as -1-padded bucket indices, 3-lane int32 timestamps, arena
-    lanes) -- shared by the dry-run and the sharded-vs-single differential
-    tests so the invariants live in one place."""
+    (CSR subject entries padded with out-of-bounds row B, 3-lane int32
+    timestamps, arena lanes) -- shared by the dry-run and the
+    sharded-vs-single differential tests so the invariants live in one
+    place."""
     from accord_tpu.ops.encoding import WITNESS_TABLE
     rng = np.random.default_rng(seed)
-    sk = np.where(rng.random((b, maxk)) < 0.4,
-                  rng.integers(0, k, (b, maxk)), -1).astype(np.int32)
+    live = rng.random(nnz) < 0.6
+    subj_of = np.where(live, rng.integers(0, b, nnz), b).astype(np.int32)
+    subj_keys = rng.integers(0, k, nnz).astype(np.int32)
     sb = np.stack([np.zeros(b, np.int32),
                    rng.integers(1000, 100_000, b).astype(np.int32),
                    rng.integers(0, 100, b).astype(np.int32)], 1)
@@ -235,5 +317,5 @@ def example_resolve_batch(cap: int = 512, k: int = 256, b: int = 16,
                        rng.integers(0, 100, cap).astype(np.int32)], 1)
     act_kinds = rng.integers(0, 5, cap).astype(np.int32)
     act_valid = rng.random(cap) < 0.9
-    return (sk, sb, sknd, act_bm, act_ts, act_kinds, act_valid,
-            WITNESS_TABLE.copy())
+    return (subj_of, subj_keys, sb, sknd, act_bm, act_ts, act_kinds,
+            act_valid, WITNESS_TABLE.copy())

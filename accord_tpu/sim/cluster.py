@@ -28,6 +28,7 @@ class ClusterConfig:
                  key_domain: int = 1 << 16, stores_per_node: int = 2,
                  timeout_ms: float = 1000.0, deps_resolver_factory=None,
                  deps_batch_window_ms=0.0, device_latency_ms: float = 4.0,
+                 device_poll_ms=None,
                  progress: bool = True, progress_interval_ms: float = 250.0,
                  progress_stall_ms: float = 1500.0,
                  progress_home_defer: float = 3.0,
@@ -48,6 +49,11 @@ class ClusterConfig:
         self.deps_resolver_factory = deps_resolver_factory
         self.deps_batch_window_ms = deps_batch_window_ms  # None = inline
         self.device_latency_ms = device_latency_ms  # async harvest delay
+        # readiness-poll cadence for early harvest of in-flight device calls.
+        # Default OFF under the sim scheduler: poll events consume sim
+        # sequence numbers, so enabling them perturbs otherwise-identical
+        # burns. Real-device deploys (maelstrom) default it on.
+        self.device_poll_ms = device_poll_ms
         self.progress = progress  # enable the liveness/recovery engine
         self.progress_interval_ms = progress_interval_ms
         self.progress_stall_ms = progress_stall_ms
@@ -273,6 +279,7 @@ class Cluster:
                            if self.config.deps_resolver_factory else None),
             deps_batch_window_ms=self.config.deps_batch_window_ms,
             device_latency_ms=self.config.device_latency_ms,
+            device_poll_ms=self.config.device_poll_ms,
         )
         if engine is not None:
             engine.bind(node)

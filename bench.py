@@ -182,6 +182,8 @@ def bench_pipeline(quick: bool):
     pre0 = resolver.prefetched
     stale0 = resolver.stale_harvests
     fall0 = resolver.host_fallbacks
+    from accord_tpu.ops.kernels import jit_cache_sizes
+    cache0 = jit_cache_sizes()   # warmup must have covered every jit tier
     chunk_walls = []
     chunk_sizes = []
     enqueued = 0
@@ -214,6 +216,15 @@ def bench_pipeline(quick: bool):
         raise AssertionError(
             f"large replay hit {resolver.host_fallbacks - fall0} stale-arena "
             "host fallbacks (generation pinning should translate instead)")
+    if resolver.host_only:
+        raise AssertionError(
+            f"retired host_only residual ran {resolver.host_only} times "
+            "(the CSR encoding must keep every subject width on device)")
+    cache1 = jit_cache_sizes()
+    if cache1 != cache0:
+        raise AssertionError(
+            f"jit tiers compiled inside the timed window: {cache0} -> "
+            f"{cache1} (warmup coverage is stale)")
     per_op = np.asarray(chunk_walls) / np.asarray(chunk_sizes) * 1e6
     host_projected_s = replay_ops * (host_mean / 1e6)
 
@@ -250,6 +261,10 @@ def bench_pipeline(quick: bool):
             "prefetched": resolver.prefetched - pre0,
             "stale_harvests": resolver.stale_harvests - stale0,
             "host_fallbacks": resolver.host_fallbacks - fall0,
+            "host_only_residual": resolver.host_only,      # asserted 0
+            "range_fallbacks": resolver.range_fallbacks,
+            "upload_bytes": resolver.upload_bytes,
+            "recompiles_in_window": 0,                      # asserted above
             "host_serial_projected_s": round(host_projected_s, 1),
             "vs_host_serial": round(host_projected_s / max(replay_wall, 1e-9), 2),
         },
@@ -322,6 +337,9 @@ def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool):
             "prefetched": sum(r.prefetched for r in resolvers),
             "stale_harvests": sum(r.stale_harvests for r in resolvers),
             "host_fallbacks": sum(r.host_fallbacks for r in resolvers),
+            "host_only_residual": sum(r.host_only for r in resolvers),
+            "range_fallbacks": sum(r.range_fallbacks for r in resolvers),
+            "upload_bytes": sum(r.upload_bytes for r in resolvers),
         }
     else:
         stats = {
@@ -354,6 +372,74 @@ def bench_e2e(quick: bool):
         "failed": {"host": host_rep.failed, "device": dev_rep.failed},
         "host": host_stats,
         "device": dev_stats,
+    }
+
+
+# ---------------------------------------------------------------------------
+# 2b. range-heavy mix: 20% range txns, fully device-resident deps
+# ---------------------------------------------------------------------------
+
+def bench_range_mix(quick: bool):
+    """Contended burn with ~20% range-domain txns on the device path: range
+    subjects and range conflicts resolve through the interval arena (no
+    host_calculate_deps, no host_range_deps union), so the retired-residual
+    counters must stay zero; run twice (readiness poll armed) to prove
+    polled burns replay bit-identically."""
+    from accord_tpu.ops.resolver import BatchDepsResolver
+    from accord_tpu.sim.burn import run_burn
+    from accord_tpu.sim.cluster import ClusterConfig
+
+    ops = 150 if quick else 400
+
+    def leg():
+        resolvers = []
+
+        def factory():
+            r = BatchDepsResolver(num_buckets=E2E_BUCKETS,
+                                  initial_cap=E2E_ARENA_CAP,
+                                  max_dispatch=256)
+            resolvers.append(r)
+            return r
+
+        cfg = ClusterConfig(
+            num_nodes=5, rf=3,
+            deps_resolver_factory=factory,
+            deps_batch_window_ms=2.0, device_latency_ms=8.0,
+            device_poll_ms=1.0,     # polled: the prefetch path under test
+            durability=True, durability_interval_ms=1000.0,
+            timeout_ms=8000.0, preaccept_timeout_ms=8000.0,
+            progress_stall_ms=5000.0)
+        t0 = time.perf_counter()
+        rep = run_burn(21, ops=ops, key_count=HOT_KEYS, zipf_theta=0.99,
+                       write_ratio=0.6, range_read_ratio=0.1,
+                       range_write_ratio=0.1, collect_log=True, config=cfg)
+        return time.perf_counter() - t0, rep, resolvers
+
+    wall_a, rep_a, res_a = leg()
+    wall_b, rep_b, _ = leg()
+    if rep_a.log != rep_b.log:
+        raise AssertionError("polled range-mix burn is not replay-identical")
+    if rep_a.lost:
+        raise AssertionError(f"range-mix burn lost {rep_a.lost} acked txns")
+    counters = {
+        "host_fallbacks": sum(r.host_fallbacks for r in res_a),
+        "host_only_residual": sum(r.host_only for r in res_a),
+        "range_fallbacks": sum(r.range_fallbacks for r in res_a),
+    }
+    bad = {k: v for k, v in counters.items() if v}
+    if bad:
+        raise AssertionError(f"range-mix burn left the device path: {bad}")
+    return {
+        "ops": ops,
+        "range_ratio": 0.2,
+        "acked": rep_a.acked,
+        "failed": rep_a.failed,
+        "wall_s": {"first": round(wall_a, 1), "replay": round(wall_b, 1)},
+        "replay_identical": True,
+        **counters,
+        "stale_harvests": sum(r.stale_harvests for r in res_a),
+        "prefetched": sum(r.prefetched for r in res_a),
+        "upload_bytes": sum(r.upload_bytes for r in res_a),
     }
 
 
@@ -472,14 +558,21 @@ def main(argv=None) -> int:
         t0 = time.perf_counter()
         warmup(num_buckets=E2E_BUCKETS, cap=E2E_ARENA_CAP,
                batch_tiers=(8, 64, 128, 256), scatter_tiers=(8, 64))
+        # the large replay's admission windows dispatch anywhere between 129
+        # and PIPE_BATCH subjects (~4 keys each), so every intermediate
+        # subject tier and the 4096-entry CSR tier must be pre-compiled for
+        # the zero-recompile assertion to hold in the timed window
         warmup(num_buckets=PIPE_BUCKETS, cap=PIPE_CAP,
-               batch_tiers=(8, 64, 128, PIPE_BATCH), scatter_tiers=(8, 64))
+               batch_tiers=(8, 64, 128, 256, 512, PIPE_BATCH),
+               scatter_tiers=(8, 64),
+               nnz_tiers=(32, 256, 2048, 4096))
         warm_s = time.perf_counter() - t0
 
         pipeline = bench_pipeline(args.quick)
         dag = bench_dag(args.quick)
         maelstrom = bench_maelstrom(args.quick)
         e2e = bench_e2e(args.quick)
+        range_mix = bench_range_mix(args.quick)
 
         print(json.dumps({
             "metric": "preaccept_deps_block_us_at_10k_inflight",
@@ -493,6 +586,7 @@ def main(argv=None) -> int:
                 "dag_100k": dag,
                 "maelstrom": maelstrom,
                 "e2e_contended": e2e,
+                "range_mix": range_mix,
             },
         }))
         return 0

@@ -84,6 +84,7 @@ def test_compaction_with_two_calls_in_flight():
     # both harvests crossed the compaction on the DEVICE path
     assert resolver.stale_harvests == 2
     assert resolver.host_fallbacks == 0
+    assert resolver.host_only == 0   # retired residual must never run
     # drained: pins released, snapshot dropped, poll disarmed
     cluster.queue.drain(max_events=10_000)
     assert gen0 not in arena.retired_ids
@@ -129,6 +130,7 @@ def test_harvest_order_and_reuse_after_compaction():
     assert out1.done
     assert resolver.stale_harvests == 1  # unchanged
     assert resolver.host_fallbacks == 0
+    assert resolver.host_only == 0
     host = store.host_calculate_deps(t1, Keys(live_keys[28]), before1)
     assert out1.value() == host
 

@@ -1,0 +1,218 @@
+"""Device-resident range deps: the interval arena + CSR subject encoding
+must answer EXACTLY what the host scans answer, for every mix of key/range
+subjects against key/range conflict state -- including subject rows wider
+than the retired MAXK=16 scatter, truncation/prune of range txns, and the
+range arena compacting while calls are in flight. The retired-residual
+counters (host_only, host_fallbacks, range_fallbacks) must stay zero
+throughout: any nonzero means the device path silently left the kernel."""
+from __future__ import annotations
+
+import numpy as np
+
+from accord_tpu.local.cfk import CfkStatus
+from accord_tpu.ops.resolver import BatchDepsResolver
+from accord_tpu.primitives.keyspace import Keys, Range, Ranges
+from accord_tpu.primitives.timestamp import Domain, Timestamp, TxnId, TxnKind
+from tests.test_local_engine import setup_store
+
+DOMAIN = 1 << 16
+
+
+def _register_mixed(store, node, rng, n_key=60, n_range=40):
+    """Key txns (some wider than the old MAXK=16) + range txns, registered
+    through the store funnel so the attached resolver mirrors them."""
+    tss = []
+    rids = []
+    for i in range(n_key):
+        ts = node.unique_now()
+        kind = TxnKind.WRITE if i % 3 else TxnKind.READ
+        tid = TxnId.create(ts.epoch, ts.hlc, ts.node, kind, Domain.KEY)
+        width = 40 if i % 11 == 0 else 1 + int(rng.integers(0, 4))
+        keys = Keys(sorted({int(k) for k in rng.integers(0, DOMAIN, width)}))
+        store.register(tid, keys, CfkStatus.WITNESSED, ts)
+        tss.append(ts)
+    for i in range(n_range):
+        ts = node.unique_now()
+        kind = TxnKind.WRITE if i % 2 else TxnKind.READ
+        tid = TxnId.create(ts.epoch, ts.hlc, ts.node, kind, Domain.RANGE)
+        pieces = []
+        for _ in range(1 + int(rng.integers(0, 2))):
+            s = int(rng.integers(0, DOMAIN - 64))
+            pieces.append(Range(s, s + 1 + int(rng.integers(0, 2048))))
+        store.register(tid, Ranges(pieces), CfkStatus.WITNESSED, ts)
+        rids.append(tid)
+        tss.append(ts)
+    return rids, tss
+
+
+def _subjects(store, node, rng, tss, n=40):
+    far = Timestamp(node.epoch, node.time_service.now_micros() + 50_000,
+                    0, node.id)
+    subs = []
+    for i in range(n):
+        kind = TxnKind.WRITE if i % 2 else TxnKind.READ
+        if i % 3 == 0:
+            pieces = [Range(s, s + 1 + int(rng.integers(0, 4096)))
+                      for s in (int(rng.integers(0, DOMAIN - 64)),)]
+            if i % 6 == 0:
+                s2 = int(rng.integers(0, DOMAIN - 64))
+                pieces.append(Range(s2, s2 + 1 + int(rng.integers(0, 512))))
+            owned = store.owned(Ranges(pieces))
+            tid = node.next_txn_id(kind, Domain.RANGE)
+        else:
+            width = 24 if i % 9 == 0 else 1 + int(rng.integers(0, 4))
+            owned = store.owned(Keys(sorted(
+                {int(k) for k in rng.integers(0, DOMAIN, width)})))
+            tid = node.next_txn_id(kind, Domain.KEY)
+        # mixed bounds: mostly future (sees everything), sometimes a
+        # registered txn's timestamp (exercises the lex-before mask)
+        before = far if i % 4 else tss[int(rng.integers(0, len(tss)))]
+        subs.append((tid, owned, before))
+    return subs
+
+
+def _assert_counters_zero(resolver):
+    assert resolver.host_fallbacks == 0
+    assert resolver.host_only == 0
+    assert resolver.range_fallbacks == 0
+
+
+def test_randomized_mixed_differential():
+    rng = np.random.default_rng(42)
+    _, node, store = setup_store()
+    resolver = BatchDepsResolver(num_buckets=128, initial_cap=128)
+    store.deps_resolver = resolver   # registrations funnel via on_register
+    _, tss = _register_mixed(store, node, rng)
+
+    arena = resolver._arenas[id(node)]
+    # the population really exercised the retired limits: a row wider than
+    # the old MAXK scatter, and a grown interval arena
+    assert max(len(m) for m in arena.row_mods if m is not None) > 16
+    assert arena.ranges.count > 0
+
+    key_deps_seen = range_deps_seen = 0
+    for tid, owned, before in _subjects(store, node, rng, tss):
+        host = store.host_calculate_deps(tid, owned, before)
+        dev = resolver.resolve_one(store, tid, owned, before)
+        assert dev == host, f"subject {tid} ({type(owned).__name__})"
+        key_deps_seen += bool(host.key_deps.all_txn_ids())
+        range_deps_seen += bool(host.range_deps.all_txn_ids())
+    assert key_deps_seen > 0 and range_deps_seen > 0, "differential vacuous"
+    _assert_counters_zero(resolver)
+
+
+def test_range_truncation_and_prune():
+    """Mirror store._deregister for half the range txns (range_txns/
+    range_index popped, then the resolver's on_truncate hook); the arena
+    must drop their rows and the differential must keep holding."""
+    rng = np.random.default_rng(7)
+    _, node, store = setup_store()
+    resolver = BatchDepsResolver(num_buckets=128, initial_cap=128)
+    store.deps_resolver = resolver
+    rids, tss = _register_mixed(store, node, rng, n_key=30, n_range=30)
+
+    arena = resolver._arenas[id(node)]
+    for tid in rids[::2]:
+        store.range_txns.pop(tid, None)
+        store.range_index.remove(tid)
+        resolver.on_truncate(store, tid)
+        assert tid not in arena.ranges.rows_of
+    for tid in rids[1::2]:
+        assert tid in arena.ranges.rows_of
+
+    nonempty = 0
+    for tid, owned, before in _subjects(store, node, rng, tss, n=24):
+        host = store.host_calculate_deps(tid, owned, before)
+        dev = resolver.resolve_one(store, tid, owned, before)
+        assert dev == host, f"subject {tid} after truncation"
+        nonempty += bool(host.range_deps.all_txn_ids())
+    assert nonempty > 0
+    # no surviving truncated id in any answer (paranoia: the re-filter at
+    # decode is what makes freed-row reuse exact)
+    truncated = set(rids[::2])
+    for tid, owned, before in _subjects(store, node, rng, tss, n=8):
+        dev = resolver.resolve_one(store, tid, owned, before)
+        assert not (set(dev.range_deps.all_txn_ids()) & truncated)
+        assert not (set(dev.key_deps.all_txn_ids()) & truncated)
+    _assert_counters_zero(resolver)
+
+
+def test_compaction_with_range_calls_in_flight():
+    """Truncate + compact the INTERVAL arena while mixed-domain calls are in
+    flight: the pinned id snapshot must translate the stale candidates (no
+    host fallback) and every answer must equal the post-truncation host
+    scan."""
+    rng = np.random.default_rng(11)
+    cluster, node, store = setup_store()
+    resolver = BatchDepsResolver(num_buckets=128, initial_cap=128)
+    store.deps_resolver = resolver
+    store.batch_window_ms = 0.5
+    node.device_latency_ms = 50.0
+    node.device_poll_ms = 1.0
+    rids, _ = _register_mixed(store, node, rng, n_key=30, n_range=40)
+
+    arena = resolver._arenas[id(node)]
+    far = Timestamp(node.epoch, node.time_service.now_micros() + 50_000,
+                    0, node.id)
+    subs = []
+    for i in range(6):
+        if i % 2 == 0:
+            s = int(rng.integers(0, DOMAIN - 4096))
+            owned = store.owned(Ranges([Range(s, s + 4096)]))
+            tid = node.next_txn_id(TxnKind.WRITE, Domain.RANGE)
+        else:
+            owned = store.owned(Keys(sorted(
+                {int(k) for k in rng.integers(0, DOMAIN, 3)})))
+            tid = node.next_txn_id(TxnKind.WRITE, Domain.KEY)
+        subs.append((tid, owned, far,
+                     resolver.enqueue_deps(store, tid, owned, far)))
+
+    while resolver.dispatches < 1:
+        assert cluster.queue.process_one(), "tick never fired"
+    assert all(not out.done for *_, out in subs)
+
+    # truncate most range txns mid-flight, then compact the interval arena
+    for tid in rids[:30]:
+        store.range_txns.pop(tid, None)
+        store.range_index.remove(tid)
+        resolver.on_truncate(store, tid)
+    rgen0 = arena.ranges.gen
+    assert arena.ranges.compact(), "compaction should reclaim truncated rows"
+    assert arena.ranges.gen == rgen0 + 1
+    # the in-flight pin forced a row->txn snapshot of the retired mapping
+    assert rgen0 in arena.ranges.retired_ids
+
+    while not all(out.done for *_, out in subs):
+        assert cluster.queue.process_one(), "harvest never fired"
+    assert resolver.stale_harvests >= 1
+    _assert_counters_zero(resolver)
+    cluster.queue.drain(max_events=10_000)
+    assert rgen0 not in arena.ranges.retired_ids  # pin released on harvest
+
+    nonempty = 0
+    for tid, owned, before, out in subs:
+        host = store.host_calculate_deps(tid, owned, before)
+        assert out.value() == host, f"subject {tid} across compaction"
+        nonempty += bool(host.range_deps.all_txn_ids()
+                         or host.key_deps.all_txn_ids())
+    assert nonempty > 0, "differential vacuous"
+
+
+def test_sharded_resolver_mixed_differential():
+    """The mesh-sharded twin answers the same mixed key/range differential
+    (rows over 'data'; the range kernel shards both arenas' rows)."""
+    from accord_tpu.ops.resolver import ShardedBatchDepsResolver
+    from accord_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(19)
+    _, node, store = setup_store()
+    resolver = ShardedBatchDepsResolver(mesh=make_mesh(),
+                                        num_buckets=256, initial_cap=512)
+    store.deps_resolver = resolver
+    _, tss = _register_mixed(store, node, rng, n_key=30, n_range=25)
+
+    for tid, owned, before in _subjects(store, node, rng, tss, n=18):
+        host = store.host_calculate_deps(tid, owned, before)
+        dev = resolver.resolve_one(store, tid, owned, before)
+        assert dev == host, f"sharded subject {tid}"
+    _assert_counters_zero(resolver)
