@@ -60,7 +60,9 @@ class Node:
         self._progress_log_factory = progress_log_factory
         self._deps_resolver = deps_resolver
         # micro-batch coalescing window for the device deps path (None =
-        # inline, no deferral; see CommandStore.submit_preaccept)
+        # inline, no deferral; see CommandStore.submit_preaccept). The
+        # window is per NODE: one tick drains every store's pending items
+        # and fuses them into a single device dispatch (ops/resolver.py)
         self.deps_batch_window_ms = deps_batch_window_ms
         # simulated dispatch->harvest delay of the async device pipeline:
         # models real accelerator latency AND gives the pipeline depth that

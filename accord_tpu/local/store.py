@@ -74,7 +74,8 @@ class CommandStore:
         self.deps_resolver = deps_resolver  # None -> host scan below
         self.exec_plane = None              # optional device exec scheduler
         # micro-batch coalescing window for the async device path (resolver
-        # owns the per-NODE tick; see ops/resolver.BatchDepsResolver):
+        # owns the per-NODE tick, which fuses EVERY store's pending items
+        # into one cross-store dispatch; see ops/resolver.BatchDepsResolver):
         # 0.0 = coalesce same-scheduler-turn arrivals; None = inline (no
         # deferral -- bit-identical timing with the host path, used by the
         # differential tests)

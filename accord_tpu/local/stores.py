@@ -5,8 +5,10 @@ splits the node's owned ranges over N single-threaded CommandStores via a
 pluggable splitter (reference: ShardDistributor.EvenSplit) and fans requests
 out with map-reduce over the intersecting stores. This is the reference's
 intra-node parallelism dimension (SURVEY.md 2.10); in the TPU build it is also
-the unit of micro-batching: each store's deps scans batch onto the device
-independently.
+the unit of micro-batching: every store's pending deps scans drain into the
+shared per-node tick, which fuses them into ONE device call per tick
+(ops/resolver.py routes results back by store-id lane; each store keeps its
+own arena and generation pins).
 """
 from __future__ import annotations
 

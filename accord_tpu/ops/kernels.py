@@ -243,7 +243,7 @@ def deps_resolve(subj_of, subj_keys, subj_before, subj_kinds,
     subj_before: i32[B, 3]     'started before' bound per subject (3-lane
                                encoding)
     subj_kinds:  i32[B]
-    act_*:       the device arena (see resolver._NodeArena); cap % 32 == 0
+    act_*:       the device arena (see resolver._StoreArena); cap % 32 == 0
     -> u32[B, cap/32] packed dependency bitmask, little-bit-first per lane
     """
     b = subj_before.shape[0]
@@ -257,6 +257,93 @@ def deps_resolve(subj_of, subj_keys, subj_before, subj_kinds,
     before = _lex_before(act_ts[None, :, :], subj_before[:, None, :])
     m = overlap & witness & before & act_valid[None, :]
     return _pack_bits(m)
+
+
+@jax.jit
+def fused_deps_resolve(subj_of, subj_keys, subj_store, subj_before,
+                       subj_kinds, slots, arenas, witness_table):
+    """Cross-store fused twin of deps_resolve: one device call answers every
+    store's slice of a node tick. `arenas` is a TUPLE of per-store lane
+    tuples (bitmaps, ts, kinds, valid) -- jit specializes on the tuple
+    structure, so the participating-store count is a warmable tier exactly
+    like the batch size. The subject bitmap is built ONCE from the CSR; each
+    store's block masks by the store-id lane (subj_store == slots[s]) so a
+    subject only sees its own store's rows, and the per-store packed blocks
+    concatenate into one u32[B, sum(cap_s)/32] readback whose word offsets
+    are the host-side row-offset table.
+
+    subj_store: i32[B]   group slot per subject (padding rows use a slot no
+                         entry of `slots` matches)
+    slots:      i32[S]   the group slot each arena block answers (traced, so
+                         slot assignment never recompiles)
+    arenas:     tuple of S (bitmaps f32[cap_s, K], ts i32[cap_s, 3],
+                kinds i32[cap_s], valid bool[cap_s])
+    -> u32[B, sum(cap_s)/32] packed dependency bitmask, store blocks in
+       `arenas` order
+    """
+    b = subj_before.shape[0]
+    k = arenas[0][0].shape[1]
+    subj_bm = jnp.zeros((b, k), jnp.float32) \
+        .at[subj_of, subj_keys].max(1.0, mode="drop").astype(jnp.bfloat16)
+    outs = []
+    for s, (act_bm, act_ts, act_kinds, act_valid) in enumerate(arenas):
+        overlap = jax.lax.dot_general(
+            subj_bm, act_bm.astype(jnp.bfloat16),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) > 0.5
+        witness = witness_table[subj_kinds[:, None], act_kinds[None, :]] == 1
+        before = _lex_before(act_ts[None, :, :], subj_before[:, None, :])
+        mine = (subj_store == slots[s])[:, None]
+        outs.append(_pack_bits(
+            overlap & witness & before & act_valid[None, :] & mine))
+    return jnp.concatenate(outs, axis=1)
+
+
+@jax.jit
+def fused_range_deps_resolve(iv_of, iv_start, iv_end, subj_store,
+                             subj_before, subj_kinds, subj_is_range,
+                             r_slots, rarenas, k_slots, karenas,
+                             witness_table):
+    """Cross-store fused twin of range_deps_resolve. `rarenas` holds the
+    participating stores' RANGE-arena lanes (starts, ends, ts, kinds, valid),
+    `karenas` the stores' key-arena hull lanes (kmin, kmax, ts, kinds,
+    valid); either tuple may be empty (that side returns a zero-width
+    buffer). Store routing works like fused_deps_resolve: each block masks
+    by its slot in the subj_store lane, and blocks concatenate along the
+    packed word axis in tuple order.
+
+    -> (u32[B, sum(rcap_s)/32], u32[B, sum(cap_s)/32])
+    """
+    b = subj_before.shape[0]
+    routs = []
+    for s, (r_start, r_end, r_ts, r_kinds, r_valid) in enumerate(rarenas):
+        rcap = r_start.shape[0]
+        hit_r = (iv_start[:, None] < r_end[None, :]) \
+            & (r_start[None, :] < iv_end[:, None])
+        any_r = jnp.zeros((b, rcap), jnp.int32) \
+            .at[iv_of].max(hit_r.astype(jnp.int32), mode="drop") > 0
+        witness_r = witness_table[subj_kinds[:, None], r_kinds[None, :]] == 1
+        before_r = _lex_before(r_ts[None, :, :], subj_before[:, None, :])
+        mine = (subj_store == r_slots[s])[:, None]
+        routs.append(_pack_bits(
+            any_r & witness_r & before_r & r_valid[None, :] & mine))
+    kouts = []
+    for s, (k_kmin, k_kmax, k_ts, k_kinds, k_valid) in enumerate(karenas):
+        cap = k_kmin.shape[0]
+        hit_k = (iv_start[:, None] <= k_kmax[None, :]) \
+            & (k_kmin[None, :] < iv_end[:, None])
+        any_k = jnp.zeros((b, cap), jnp.int32) \
+            .at[iv_of].max(hit_k.astype(jnp.int32), mode="drop") > 0
+        witness_k = witness_table[subj_kinds[:, None], k_kinds[None, :]] == 1
+        before_k = _lex_before(k_ts[None, :, :], subj_before[:, None, :])
+        mine = (subj_store == k_slots[s])[:, None] & subj_is_range[:, None]
+        kouts.append(_pack_bits(
+            any_k & witness_k & before_k & k_valid[None, :] & mine))
+    rpacked = jnp.concatenate(routs, axis=1) if routs \
+        else jnp.zeros((b, 0), jnp.uint32)
+    kpacked = jnp.concatenate(kouts, axis=1) if kouts \
+        else jnp.zeros((b, 0), jnp.uint32)
+    return rpacked, kpacked
 
 
 @jax.jit
@@ -331,6 +418,20 @@ def arena_scatter(bitmaps, ts, exec_ts, kinds, kmin, kmax, valid,
             kmin.at[rows].set(kmin_rows),
             kmax.at[rows].set(kmax_rows),
             valid.at[rows].set(valid_rows))
+
+
+@jax.jit
+def arena_scatter_keys(bitmaps, kmin, kmax, rows, key_rows, key_mods,
+                       kmin_rows, kmax_rows):
+    """Field-granular scatter for KEY-SET-ONLY row changes (key widening,
+    prune/truncate shrinks): rebuild the dirty rows' bitmaps from the CSR and
+    refresh their [kmin, kmax] hulls without shipping the ts/exec/kind/valid
+    lanes the change didn't touch. Same clear-then-max CSR contract as
+    arena_scatter."""
+    cleared = bitmaps.at[rows].set(0.0)
+    return (cleared.at[key_rows, key_mods].max(1.0, mode="drop"),
+            kmin.at[rows].set(kmin_rows),
+            kmax.at[rows].set(kmax_rows))
 
 
 @jax.jit
@@ -428,6 +529,10 @@ def jit_cache_sizes() -> dict:
     return {
         "deps_resolve": deps_resolve._cache_size(),
         "range_deps_resolve": range_deps_resolve._cache_size(),
+        "fused_deps_resolve": fused_deps_resolve._cache_size(),
+        "fused_range_deps_resolve": fused_range_deps_resolve._cache_size(),
         "arena_scatter": arena_scatter._cache_size(),
+        "arena_scatter_keys": arena_scatter_keys._cache_size(),
+        "scatter_rows": scatter_rows._cache_size(),
         "range_scatter": range_scatter._cache_size(),
     }

@@ -7,10 +7,11 @@ query is an SPI:
 
   HostDepsResolver  -- delegates to the store's Python scan (reference
                        behaviour, used for differential testing)
-  BatchDepsResolver -- maintains an incremental DEVICE ARENA per node (all of
-                       the node's stores share it) and answers deps queries
-                       with one fused MXU kernel per node tick, fully
-                       asynchronously.
+  BatchDepsResolver -- maintains an incremental DEVICE ARENA per STORE
+                       (mirroring the reference's shard-per-CommandStore
+                       layout) and answers the whole node tick's deps queries
+                       -- across ALL of the node's stores -- with ONE fused
+                       MXU kernel call, fully asynchronously.
 
 Why the shape of this design (measured on the target TPU-via-tunnel setup):
   - kernel enqueue is ~17 us but ANY synchronous device->host readback costs
@@ -24,19 +25,24 @@ Why the shape of this design (measured on the target TPU-via-tunnel setup):
 
 Range txns live in a SECOND device mirror (_RangeArena): active ranges as
 sorted-endpoint int32 pairs, one row per (txn, interval). Every dispatch that
-touches range state also runs the fused range_deps_resolve kernel -- key
-subjects stab the interval rows with point intervals, range subjects overlap
-both the interval rows and the key arena's per-row [kmin, kmax] key hulls --
-so range-domain subjects ride the same dispatch/harvest pipeline and the old
-per-harvest host scans (host_range_deps union, the > MAXK host_only residual)
-are retired. Decode stays exact: candidate rows translate to txn ids and are
-re-filtered host-side per real key/range before entering the Deps.
+touches range state also runs the fused range kernel -- key subjects stab the
+interval rows with point intervals, range subjects overlap both the interval
+rows and the key arena's per-row [kmin, kmax] key hulls -- so range-domain
+subjects ride the same dispatch/harvest pipeline and the old per-harvest host
+scans are retired. Decode stays exact: candidate rows translate to txn ids
+and are re-filtered host-side per real key/range before entering the Deps.
 
 Async protocol (deterministic, overlapped): a node tick drains every store's
 queued PreAccepts/deps queries, runs the host-side preaccept transitions
-(witness timestamps come from the O(1) host MaxConflicts map), dispatches ONE
-kernel call per max_dispatch slice (enqueue + copy_to_host_async -- no
-blocking), and appends the call to the node's IN-ORDER in-flight queue. Three
+(witness timestamps come from the O(1) host MaxConflicts map), and dispatches
+ONE FUSED CROSS-STORE kernel call per max_dispatch slice (enqueue +
+copy_to_host_async -- no blocking): every participating store's arena lanes
+enter the same call as a tuple block, a store-id lane routes each subject to
+its own store's rows, and the per-store word spans of the concatenated packed
+result (the row-offset table, recorded per _Group at encode time) route the
+readback to each store's decode. Generation pinning stays PER STORE, so one
+store compacting mid-flight never invalidates a batchmate's rows. Each call
+appends to the node's IN-ORDER in-flight queue. Three
 stages then overlap in real time: host-encode of call N+1 (the next tick),
 device-execute of call N, and host-decode of call N-1 (its harvest event).
 Between dispatch and harvest a cheap deterministic POLL (sim/scheduler.py
@@ -113,21 +119,28 @@ class HostDepsResolver(DepsResolver):
 def warmup(num_buckets: int = 1024, cap: int = 8192,
            batch_tiers=(8, 64, 128), scatter_tiers=(8, 64),
            nnz_tiers=None, scatter_nnz_tiers=None,
-           range_cap: int = 64) -> None:
+           range_cap: int = 64, store_tiers=(1, 2)) -> None:
     """Pre-compile the jit shape tiers the async pipeline uses (first
     compilation costs seconds on a tunnelled TPU; production would do the
     same at process start). The jit cache is process-global, so one call
     covers every resolver with the same (num_buckets, cap, range_cap).
 
     The CSR encoding makes each kernel's shape a (batch tier, nnz tier)
-    PAIR, so warmup compiles the cross product -- a handful of variants,
-    bounded by the deliberately short tier ladders in ops/kernels.py. The
-    bench asserts zero recompiles inside its timed window against exactly
-    this coverage (kernels.jit_cache_sizes)."""
+    PAIR, and the fused cross-store kernels add a third axis: the
+    participating-store count (`store_tiers` -- jit specializes on the
+    arena-tuple structure). Warmup compiles the cross product -- a handful
+    of variants, bounded by the deliberately short tier ladders in
+    ops/kernels.py. The bench asserts zero recompiles inside its timed
+    windows against exactly this coverage (kernels.jit_cache_sizes),
+    including the field-granular delta scatters (arena_scatter_keys and the
+    single-lane scatter_rows used by ts-only / valid-only updates)."""
     import jax.numpy as jnp
     from accord_tpu.ops.kernels import (NNZ_TIERS, SCATTER_NNZ_TIERS,
-                                        arena_scatter, deps_resolve,
-                                        range_deps_resolve, range_scatter)
+                                        arena_scatter, arena_scatter_keys,
+                                        deps_resolve, fused_deps_resolve,
+                                        fused_range_deps_resolve,
+                                        range_deps_resolve, range_scatter,
+                                        scatter_rows)
     if nnz_tiers is None:
         nnz_tiers = NNZ_TIERS
     if scatter_nnz_tiers is None:
@@ -156,15 +169,26 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
                 jnp.zeros((m, 3), jnp.int32), jnp.zeros((m, 3), jnp.int32),
                 jnp.zeros(m, jnp.int32), jnp.full(m, pos, jnp.int32),
                 jnp.full(m, neg, jnp.int32), jnp.zeros(m, bool))
+            out = arena_scatter_keys(
+                bm, kmin, kmax, jnp.zeros(m, jnp.int32),
+                jnp.full(z, cap, jnp.int32), jnp.zeros(z, jnp.int32),
+                jnp.full(m, pos, jnp.int32), jnp.full(m, neg, jnp.int32))
         out = range_scatter(
             rs, re_, rts, rkd, rvl, jnp.zeros(m, jnp.int32),
             jnp.zeros(m, jnp.int32), jnp.zeros(m, jnp.int32),
             jnp.zeros((m, 3), jnp.int32), jnp.zeros(m, jnp.int32),
             jnp.zeros(m, bool))
+        # the field-granular single-lane deltas: exec-ts bumps, key-arena
+        # valid flips, range-arena valid flips
+        out = scatter_rows(ex, jnp.zeros(m, jnp.int32),
+                           jnp.zeros((m, 3), jnp.int32))
+        out = scatter_rows(vl, jnp.zeros(m, jnp.int32), jnp.zeros(m, bool))
+        out = scatter_rows(rvl, jnp.zeros(m, jnp.int32), jnp.zeros(m, bool))
     for b in batch_tiers:
         sb = jnp.zeros((b, 3), jnp.int32)
         sknd = jnp.zeros(b, jnp.int32)
         srng = jnp.zeros(b, bool)
+        sst = jnp.zeros(b, jnp.int32)
         for z in nnz_tiers:
             of = jnp.full(z, b, jnp.int32)
             zz = jnp.zeros(z, jnp.int32)
@@ -172,16 +196,43 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
             out = range_deps_resolve(of, zz, zz, sb, sknd, srng,
                                      rs, re_, rts, rkd, rvl,
                                      kmin, kmax, ts, kd, vl, table)
+            for s in store_tiers:
+                if s < 2:
+                    continue  # single-group dispatches use the plain kernels
+                slots = jnp.arange(s, dtype=jnp.int32)
+                arenas = tuple((bm, ts, kd, vl) for _ in range(s))
+                out = fused_deps_resolve(of, zz, sst, sb, sknd, slots,
+                                         arenas, table)
+                rarenas = tuple((rs, re_, rts, rkd, rvl) for _ in range(s))
+                karenas = tuple((kmin, kmax, ts, kd, vl) for _ in range(s))
+                out = fused_range_deps_resolve(of, zz, zz, sst, sb, sknd,
+                                               srng, slots, rarenas, slots,
+                                               karenas, table)
     if out is not None:
         import jax
         jax.block_until_ready(out)
 
 
-class _NodeArena:
-    """Incremental device mirror of one NODE's key-domain active set (rows
-    keyed by txn id; a txn registering in several of the node's stores
-    accumulates the union of its owned keys in one row -- exact per-key
-    recovery at harvest filters cross-store/bucket false positives).
+class _NodeEncoder:
+    """The per-NODE timestamp-encoder cell shared by every store arena on
+    the node: the fused cross-store kernels compare all subject/row
+    timestamps in ONE encoding window, so the window anchors once per node
+    (by whichever store sees a timestamp first), not once per store."""
+
+    __slots__ = ("encoder",)
+
+    def __init__(self):
+        self.encoder: Optional[TimestampEncoder] = None
+
+
+class _StoreArena:
+    """Incremental device mirror of one STORE's key-domain active set (rows
+    keyed by txn id). Arenas are per store -- mirroring the reference's
+    shard-per-CommandStore layout -- so compaction, growth, and generation
+    pins stay store-local while the node tick fuses every store's pending
+    subjects into ONE kernel call over the concatenation of their arena
+    blocks (exact per-key recovery at harvest filters bucket false
+    positives).
 
     Device arrays (authoritative once scattered): bitmaps f32[cap, K],
     ts i32[cap, 3], exec_ts i32[cap, 3], kinds i32[cap], kmin/kmax i32[cap]
@@ -189,12 +240,15 @@ class _NodeArena:
     bool[cap]. Host shadows exist only to source dirty-row scatters and
     exact key sets. Key lists upload as a variable-width CSR, so arbitrarily
     wide rows stay on the device path (no MAXK demotion, no host residual).
+    Uploads are FIELD-GRANULAR: a row whose only change is an exec-ts bump
+    (the common status path) ships one int32 triple, not the whole row.
     """
 
     GROW = 2
 
     def __init__(self, num_buckets: int, initial_cap: int = 4096,
-                 range_cap: int = 64):
+                 range_cap: int = 64,
+                 shared_encoder: Optional[_NodeEncoder] = None):
         self.num_buckets = num_buckets
         self.cap = initial_cap
         self.count = 0
@@ -204,7 +258,8 @@ class _NodeArena:
         self.ids_np = np.empty(self.cap, dtype=object)
         self.key_sets: List[frozenset] = []
         self.row_of: Dict[TxnId, int] = {}
-        self.encoder: Optional[TimestampEncoder] = None
+        self._enc = shared_encoder if shared_encoder is not None \
+            else _NodeEncoder()
         self.exec_max: List[Optional[Timestamp]] = []
         # host shadows for scatter sourcing
         self.ts = np.zeros((self.cap, 3), dtype=np.int32)
@@ -231,7 +286,14 @@ class _NodeArena:
         # historical key coverage -- the (monotone) max-conflict kernel must
         # defer to the host map from then on
         self.had_truncation = False
-        self._dirty_rows: set = set()
+        # field-granular dirty masks: `full` rows re-upload every lane (new
+        # rows, device re-init); `keys`/`ts`/`valid` rows ship only that
+        # lane group. A row in `full` never also sits in a granular set
+        # (see _mark_dirty), so no lane uploads twice.
+        self._dirty_full: set = set()
+        self._dirty_keys: set = set()
+        self._dirty_ts: set = set()
+        self._dirty_valid: set = set()
         self._device = None
         # bumped by compact(): in-flight async calls hold packed rows in the
         # OLD row mapping. Dispatch pins the generation it encoded against;
@@ -244,20 +306,36 @@ class _NodeArena:
         # ts[row] is written once at row creation, so it only invalidates on
         # compaction (gen) or growth of the live prefix (count)
         self._rank = None
-        # bytes shipped host->device by dirty-row scatters (bench counter)
+        # bytes shipped host->device by dirty-row scatters (bench counters):
+        # total, broken out per field group, and the bytes the retired
+        # all-lanes scheme would have shipped for the same dirty sets (the
+        # baseline the field-granular deltas are measured against)
         self.upload_bytes = 0
-        # the node's ACTIVE RANGE TXNS, mirrored as interval rows; shares
-        # this arena's timestamp encoder so the kernels' before-compares are
+        self.upload_bytes_by_field = {"full": 0, "keys": 0, "ts": 0,
+                                      "valid": 0}
+        self.upload_bytes_full_equiv = 0
+        # the store's ACTIVE RANGE TXNS, mirrored as interval rows; shares
+        # the node's timestamp encoder so the kernels' before-compares are
         # in one window
         self.ranges = _RangeArena(self, range_cap)
 
+    @property
+    def encoder(self) -> Optional[TimestampEncoder]:
+        return self._enc.encoder
+
     # -- host-side mutation ---------------------------------------------------
     def _ensure_encoder(self, ts: Timestamp) -> None:
-        if self.encoder is None:
+        if self._enc.encoder is None:
             # base epoch 0: epochs are small ints, and the epoch delta must
             # stay non-negative even when an OLDER-epoch txn registers after
             # a newer one; the hlc window is symmetric around the first hlc
-            self.encoder = TimestampEncoder(0, ts.hlc)
+            # (the cell is node-shared: sibling store arenas join the window)
+            self._enc.encoder = TimestampEncoder(0, ts.hlc)
+
+    def _mark_dirty(self, row: int, field_set: set) -> None:
+        # a row queued for a full upload already ships every lane
+        if row not in self._dirty_full:
+            field_set.add(row)
 
     def _grow_host(self) -> None:
         new_cap = self.cap * self.GROW
@@ -336,7 +414,10 @@ class _NodeArena:
             for k in old_keys[old_row]:
                 self._set_key_row_bit(k, row)
         self._device = None
-        self._dirty_rows = set()
+        self._dirty_full = set()
+        self._dirty_keys = set()
+        self._dirty_ts = set()
+        self._dirty_valid = set()
         self.gen += 1
         return True
 
@@ -415,6 +496,7 @@ class _NodeArena:
             self._set_row_keys(row)
             for k in key_set:
                 self._set_key_row_bit(k, row)
+            self._dirty_full.add(row)
         elif key_set and not (key_set <= self.key_sets[row]):
             # a later registration may widen the key set (partial txn unions)
             # -- including invalidations, whose keys must stay visible to the
@@ -423,18 +505,20 @@ class _NodeArena:
                 self._set_key_row_bit(k, row)
             self.key_sets[row] = self.key_sets[row] | frozenset(key_set)
             self._set_row_keys(row)
+            self._mark_dirty(row, self._dirty_keys)
         # MaxConflicts is monotone in the reference: even an invalidated
         # txn's registration bumps the conflict floor
         prev = self.exec_max[row]
         if prev is None or conflict_ts > prev:
             self.exec_max[row] = conflict_ts
             self.exec_ts[row] = self.encoder.encode_one(conflict_ts)
+            self._mark_dirty(row, self._dirty_ts)
         if status == CfkStatus.INVALIDATED:
             # drops the row from deps scans (a dep that never applies);
             # never reset -- invalidation is terminal
             self.valid[row] = False
             self.invalidated.add(row)
-        self._dirty_rows.add(row)
+            self._mark_dirty(row, self._dirty_valid)
 
     def _set_row_keys(self, row: int) -> None:
         ks = self.key_sets[row]
@@ -563,13 +647,15 @@ class _NodeArena:
         self.key_sets[row] = remaining
         self.had_truncation = True
         self._set_row_keys(row)
+        self._mark_dirty(row, self._dirty_keys)
         if not remaining:
             self.valid[row] = False
-        self._dirty_rows.add(row)
+            self._mark_dirty(row, self._dirty_valid)
 
     # -- device sync ----------------------------------------------------------
     def device_arrays(self):
         import jax.numpy as jnp
+        from accord_tpu.ops.kernels import scatter_nnz_tier
         if self._device is None:
             neg = np.iinfo(np.int32).min
             pos = np.iinfo(np.int32).max
@@ -582,27 +668,59 @@ class _NodeArena:
                 jnp.full(self.cap, neg, jnp.int32),
                 jnp.zeros(self.cap, bool),
             )
-            self._dirty_rows = set(range(self.count))
-        if self._dirty_rows:
-            rows = sorted(self._dirty_rows)
-            # greedy chunks bounded in BOTH rows (<= 64) and flat CSR key
-            # entries (<= SCATTER_NNZ_TIERS[-1]) so the jit shape tiers stay
-            # few and warmable; a single ultra-wide row gets its own
-            # power-of-two nnz bucket
-            lo = 0
-            while lo < len(rows):
-                hi = lo + 1
-                nnz = len(self.row_mods[rows[lo]])
-                while hi < len(rows) and hi - lo < 64:
-                    w = len(self.row_mods[rows[hi]])
-                    if nnz + w > 512:
-                        break
-                    nnz += w
-                    hi += 1
-                self._scatter_chunk(rows[lo:hi])
-                lo = hi
-            self._dirty_rows.clear()
+            self._dirty_full = set(range(self.count))
+            self._dirty_keys.clear()
+            self._dirty_ts.clear()
+            self._dirty_valid.clear()
+        if self._dirty_full:
+            for chunk in self._csr_chunks(sorted(self._dirty_full)):
+                self._scatter_chunk(chunk)
+            # the full upload carried every lane: granular marks on the same
+            # rows are satisfied
+            self._dirty_keys -= self._dirty_full
+            self._dirty_ts -= self._dirty_full
+            self._dirty_valid -= self._dirty_full
+            self._dirty_full.clear()
+        if self._dirty_keys or self._dirty_ts or self._dirty_valid:
+            # baseline accounting FIRST, over the UNION of granular rows
+            # chunked exactly like the all-lanes scheme would have: a row
+            # dirty in several fields was still one full-row upload there
+            union = sorted(self._dirty_keys | self._dirty_ts
+                           | self._dirty_valid)
+            for chunk in self._csr_chunks(union):
+                m = 8 if len(chunk) <= 8 else 64
+                z = scatter_nnz_tier(
+                    sum(len(self.row_mods[r]) for r in chunk))
+                # idx + ts + exec_ts + kinds + kmin + kmax + valid lanes
+                # (m * 41 bytes) plus the padded CSR pair (z * 8 bytes)
+                self.upload_bytes_full_equiv += m * 41 + z * 8
+            for chunk in self._csr_chunks(sorted(self._dirty_keys)):
+                self._scatter_keys_chunk(chunk)
+            self._dirty_keys.clear()
+            self._scatter_lane(sorted(self._dirty_ts), 2, "ts", self.exec_ts)
+            self._dirty_ts.clear()
+            self._scatter_lane(sorted(self._dirty_valid), 6, "valid",
+                               self.valid)
+            self._dirty_valid.clear()
         return self._device
+
+    def _csr_chunks(self, rows: List[int]):
+        """Greedy chunks bounded in BOTH rows (<= 64) and flat CSR key
+        entries (<= SCATTER_NNZ_TIERS[-1]) so the jit shape tiers stay few
+        and warmable; a single ultra-wide row gets its own power-of-two nnz
+        bucket."""
+        lo = 0
+        while lo < len(rows):
+            hi = lo + 1
+            nnz = len(self.row_mods[rows[lo]])
+            while hi < len(rows) and hi - lo < 64:
+                w = len(self.row_mods[rows[hi]])
+                if nnz + w > 512:
+                    break
+                nnz += w
+                hi += 1
+            yield rows[lo:hi]
+            lo = hi
 
     def _scatter_chunk(self, chunk: List[int]) -> None:
         import jax.numpy as jnp
@@ -627,17 +745,70 @@ class _NodeArena:
         uploads = (idx, key_rows, key_mods, self.ts[idx], self.exec_ts[idx],
                    self.kinds[idx], self.kmin[idx], self.kmax[idx],
                    self.valid[idx])
-        self.upload_bytes += sum(a.nbytes for a in uploads)
+        nb = sum(a.nbytes for a in uploads)
+        self.upload_bytes += nb
+        self.upload_bytes_by_field["full"] += nb
+        self.upload_bytes_full_equiv += nb
         self._device = arena_scatter(
             *self._device, *(jnp.asarray(a) for a in uploads))
 
+    def _scatter_keys_chunk(self, chunk: List[int]) -> None:
+        """Key-set-only delta: rebuild the rows' bitmaps from the CSR and
+        refresh their [kmin, kmax] hulls; ts/exec/kind/valid lanes stay."""
+        import jax.numpy as jnp
+        from accord_tpu.ops.kernels import (arena_scatter_keys,
+                                            scatter_nnz_tier)
+        m = 8 if len(chunk) <= 8 else 64
+        idx = np.full(m, chunk[0], dtype=np.int32)
+        idx[:len(chunk)] = chunk
+        mods_list = [self.row_mods[r] for r in chunk]
+        counts = np.fromiter((len(a) for a in mods_list), np.int64,
+                             len(chunk))
+        total = int(counts.sum())
+        z = scatter_nnz_tier(total)
+        key_rows = np.full(z, self.cap, dtype=np.int32)
+        key_mods = np.zeros(z, dtype=np.int32)
+        if total:
+            key_rows[:total] = np.repeat(np.asarray(chunk, np.int32), counts)
+            key_mods[:total] = np.concatenate(mods_list)
+        uploads = (idx, key_rows, key_mods, self.kmin[idx], self.kmax[idx])
+        nb = sum(a.nbytes for a in uploads)
+        self.upload_bytes += nb
+        self.upload_bytes_by_field["keys"] += nb
+        d = list(self._device)
+        d[0], d[4], d[5] = arena_scatter_keys(
+            d[0], d[4], d[5], *(jnp.asarray(a) for a in uploads))
+        self._device = tuple(d)
+
+    def _scatter_lane(self, rows: List[int], lane: int, field: str,
+                      src: np.ndarray) -> None:
+        """Single-lane delta (exec-ts bumps, valid flips): ship one lane's
+        dirty rows via the generic scatter_rows kernel."""
+        if not rows:
+            return
+        import jax.numpy as jnp
+        from accord_tpu.ops.kernels import scatter_rows
+        for lo in range(0, len(rows), 64):
+            chunk = rows[lo:lo + 64]
+            m = 8 if len(chunk) <= 8 else 64
+            idx = np.full(m, chunk[0], dtype=np.int32)
+            idx[:len(chunk)] = chunk
+            data = src[idx]
+            self.upload_bytes += idx.nbytes + data.nbytes
+            self.upload_bytes_by_field[field] += idx.nbytes + data.nbytes
+            d = list(self._device)
+            d[lane] = scatter_rows(d[lane], jnp.asarray(idx),
+                                   jnp.asarray(data))
+            self._device = tuple(d)
+
 
 class _RangeArena:
-    """Incremental device mirror of one NODE's active RANGE-TXN set: one row
-    per (txn, interval), interval endpoints normalized to half-open int32
-    pairs (a _Successor endpoint encodes as key+1 -- exact for integer key
-    domains). Owned by a _NodeArena and sharing its timestamp encoder, so the
-    range kernel's before-compares live in the same window as the key arena.
+    """Incremental device mirror of one STORE's active RANGE-TXN set: one
+    row per (txn, interval), interval endpoints normalized to half-open
+    int32 pairs (a _Successor endpoint encodes as key+1 -- exact for integer
+    key domains). Owned by a _StoreArena and sharing the node's timestamp
+    encoder, so the range kernel's before-compares live in the same window
+    as every sibling arena in a fused call.
 
     Sorted-endpoint pairs instead of an interval tree: the kernel tests every
     (subject interval, row) pair with a branch-free broadcast compare -- pure
@@ -650,13 +821,13 @@ class _RangeArena:
     candidate fails the host re-check exactly like a bucket collision).
 
     A non-integer / out-of-window endpoint flips `encode_ok` False
-    permanently: the node reverts to the host range scans (counted by the
+    permanently: the store reverts to the host range scans (counted by the
     resolver as range_fallbacks; never hit by the integer key domains the
     burns and benches use)."""
 
     GROW = 2
 
-    def __init__(self, owner: "_NodeArena", initial_cap: int = 64):
+    def __init__(self, owner: "_StoreArena", initial_cap: int = 64):
         self.owner = owner
         self.cap = initial_cap          # multiple of 32 (and, sharded, of
                                         # 32*data -- see ShardedBatchDepsResolver)
@@ -675,10 +846,16 @@ class _RangeArena:
         self.invalidated_ids: set = set()
         self.encode_ok = True
         self._free: List[int] = []
-        self._dirty_rows: set = set()
+        # field-granular dirty masks, mirroring _StoreArena: dropped rows
+        # only flip the valid lane, so they ship 5 bytes/row, not the full
+        # 29-byte interval row
+        self._dirty_full: set = set()
+        self._dirty_valid: set = set()
         self._device = None
         self.upload_bytes = 0
-        # generation pinning across compact(), mirroring _NodeArena: stale
+        self.upload_bytes_by_field = {"range_full": 0, "range_valid": 0}
+        self.upload_bytes_full_equiv = 0
+        # generation pinning across compact(), mirroring _StoreArena: stale
         # harvests translate candidate rows BY TXN ID via the pinned
         # snapshot (no row translation needed -- decode re-filters against
         # current store state anyway)
@@ -719,33 +896,22 @@ class _RangeArena:
         self.invalidated_ids.add(txn_id)
         self._drop_rows(txn_id)
 
-    def truncate(self, store, txn_id: TxnId) -> None:
-        """A store truncated its record of txn_id: subtract that store's
-        slice; other stores' pieces of the row set live on."""
-        cur = self.ranges_of.get(txn_id)
-        if cur is None:
-            return
-        mine = cur.intersection(store.slice_ranges)
-        if mine.is_empty():
-            return
-        remaining = cur.difference(mine)
-        if remaining.is_empty():
+    def truncate(self, txn_id: TxnId) -> None:
+        """The owning store truncated its record of txn_id: the arena is per
+        store, so the txn's whole row set retires (the old cross-store slice
+        subtraction died with the shared node arena)."""
+        if txn_id in self.ranges_of:
             self._drop_rows(txn_id)
-            return
-        encoded = [encode_interval(r) for r in remaining]
-        if any(iv is None for iv in encoded):
-            # a slice boundary produced an unencodable endpoint: revert the
-            # node to the host range scan (same rule as update)
-            self.encode_ok = False
-            return
-        self._set_rows(txn_id, remaining, encoded)
 
     def _drop_rows(self, txn_id: TxnId) -> None:
         for r in self.rows_of.pop(txn_id, []):
             self.valid[r] = False
             self.ids_np[r] = None
             self._free.append(r)
-            self._dirty_rows.add(r)
+            # a row the device never saw (still queued full) keeps its full
+            # mark -- that upload carries valid=False
+            if r not in self._dirty_full:
+                self._dirty_valid.add(r)
         self.ranges_of.pop(txn_id, None)
         self._encoded_of.pop(txn_id, None)
 
@@ -765,7 +931,8 @@ class _RangeArena:
             self.valid[r] = False
             self.ids_np[r] = None
             self._free.append(r)
-            self._dirty_rows.add(r)
+            if r not in self._dirty_full:
+                self._dirty_valid.add(r)
         enc3 = self.owner.encoder.encode_one(txn_id)
         rows = []
         for (s, e) in encoded:
@@ -777,7 +944,8 @@ class _RangeArena:
             self.valid[row] = True
             self.ids_np[row] = txn_id
             rows.append(row)
-            self._dirty_rows.add(row)
+            self._dirty_full.add(row)
+            self._dirty_valid.discard(row)
         self.rows_of[txn_id] = rows
         self.ranges_of[txn_id] = merged
         self._encoded_of[txn_id] = encoded
@@ -835,7 +1003,8 @@ class _RangeArena:
                 rows.append(row)
             self.rows_of[t] = rows
         self._device = None
-        self._dirty_rows = set()
+        self._dirty_full = set()
+        self._dirty_valid = set()
         self.gen += 1
         return True
 
@@ -876,7 +1045,7 @@ class _RangeArena:
     # -- device sync ----------------------------------------------------------
     def device_arrays(self):
         import jax.numpy as jnp
-        from accord_tpu.ops.kernels import range_scatter
+        from accord_tpu.ops.kernels import range_scatter, scatter_rows
         if self._device is None:
             self._device = (
                 jnp.zeros(self.cap, jnp.int32),
@@ -885,9 +1054,10 @@ class _RangeArena:
                 jnp.zeros(self.cap, jnp.int32),
                 jnp.zeros(self.cap, bool),
             )
-            self._dirty_rows = set(range(self.count))
-        if self._dirty_rows:
-            rows = sorted(self._dirty_rows)
+            self._dirty_full = set(range(self.count))
+            self._dirty_valid.clear()
+        if self._dirty_full:
+            rows = sorted(self._dirty_full)
             for lo in range(0, len(rows), 64):
                 chunk = rows[lo:lo + 64]
                 m = 8 if len(chunk) <= 8 else 64
@@ -895,10 +1065,32 @@ class _RangeArena:
                 idx[:len(chunk)] = chunk
                 uploads = (idx, self.starts[idx], self.ends[idx],
                            self.ts[idx], self.kinds[idx], self.valid[idx])
-                self.upload_bytes += sum(a.nbytes for a in uploads)
+                nb = sum(a.nbytes for a in uploads)
+                self.upload_bytes += nb
+                self.upload_bytes_by_field["range_full"] += nb
+                self.upload_bytes_full_equiv += nb
                 self._device = range_scatter(
                     *self._device, *(jnp.asarray(a) for a in uploads))
-            self._dirty_rows.clear()
+            self._dirty_valid -= self._dirty_full
+            self._dirty_full.clear()
+        if self._dirty_valid:
+            rows = sorted(self._dirty_valid)
+            for lo in range(0, len(rows), 64):
+                chunk = rows[lo:lo + 64]
+                m = 8 if len(chunk) <= 8 else 64
+                idx = np.full(m, chunk[0], dtype=np.int32)
+                idx[:len(chunk)] = chunk
+                data = self.valid[idx]
+                self.upload_bytes += idx.nbytes + data.nbytes
+                self.upload_bytes_by_field["range_valid"] += \
+                    idx.nbytes + data.nbytes
+                # all-lanes baseline: the same chunk as a full range_scatter
+                self.upload_bytes_full_equiv += m * 29
+                d = list(self._device)
+                d[4] = scatter_rows(d[4], jnp.asarray(idx),
+                                    jnp.asarray(data))
+                self._device = tuple(d)
+            self._dirty_valid.clear()
         return self._device
 
 
@@ -925,23 +1117,48 @@ class _Item:
         self.fallback: Optional[str] = None
 
 
+class _Group:
+    """One store's slice of a fused cross-store dispatch: its arena, the
+    dispatch positions of its items, the generations the call encoded
+    against, and the word-column spans of its blocks inside the concatenated
+    packed results -- the per-store row-offset table that routes the fused
+    readback back to each store's decode."""
+
+    __slots__ = ("store", "arena", "idx", "items", "gen", "rgen",
+                 "pinned", "rpinned", "pk", "rp", "kp")
+
+    def __init__(self, store, arena):
+        self.store = store
+        self.arena = arena
+        self.idx: List[int] = []      # positions in the dispatch's item list
+        self.items: List[_Item] = []
+        self.gen = arena.gen
+        self.rgen = arena.ranges.gen
+        self.pinned = False           # key-arena generation pin held
+        self.rpinned = False          # range-arena generation pin held
+        # (lo, hi) word-column spans into packed/rpacked/kpacked; None when
+        # this store contributed no block to that buffer
+        self.pk: Optional[Tuple[int, int]] = None
+        self.rp: Optional[Tuple[int, int]] = None
+        self.kp: Optional[Tuple[int, int]] = None
+
+
 class _Call:
     """One in-flight kernel dispatch: up to three device result buffers
     (key-domain deps, range-arena candidates, key-arena candidates for range
-    subjects) plus the generation pins needed to decode them after a
-    compaction."""
+    subjects), the per-store groups whose spans slice them, and the
+    generation pins needed to decode after a compaction (held per group, so
+    one store compacting never disturbs a batchmate)."""
 
-    __slots__ = ("packed", "rpacked", "kpacked", "items", "arena",
-                 "gen", "rgen", "np_packed", "np_rpacked", "np_kpacked")
+    __slots__ = ("packed", "rpacked", "kpacked", "items", "groups",
+                 "np_packed", "np_rpacked", "np_kpacked")
 
-    def __init__(self, packed, rpacked, kpacked, items, arena):
-        self.packed = packed        # deps_resolve result (or None)
-        self.rpacked = rpacked      # range_deps_resolve range-arena result
-        self.kpacked = kpacked      # range_deps_resolve key-arena result
+    def __init__(self, packed, rpacked, kpacked, items, groups):
+        self.packed = packed        # fused key-domain result (or None)
+        self.rpacked = rpacked      # fused range-arena result
+        self.kpacked = kpacked      # fused key-arena hull result
         self.items = items
-        self.arena = arena
-        self.gen = arena.gen
-        self.rgen = arena.ranges.gen
+        self.groups: List[_Group] = groups
         # host copies, filled by the poll prefetch once the device finishes
         # (or by a blocking read at harvest when it hasn't)
         self.np_packed: Optional[np.ndarray] = None
@@ -971,17 +1188,22 @@ class BatchDepsResolver(DepsResolver):
     MAX_DISPATCH = 128  # subjects per kernel call (a named, warmable jit tier)
 
     def __init__(self, num_buckets: int = 256, initial_cap: int = 4096,
-                 max_dispatch: Optional[int] = None):
+                 max_dispatch: Optional[int] = None,
+                 fuse_cross_store: bool = True):
         # each dispatch pays one interconnect round trip at harvest, so on
         # high-latency links (the tunnelled bench chip) larger dispatches
         # amortize it; the default stays small to bound jit tiers in tests
         self.max_dispatch = max_dispatch or self.MAX_DISPATCH
+        # True (default): a node tick's items from ALL stores ride one fused
+        # kernel call. False: one dispatch per store per tick -- the
+        # differential baseline the fused path is tested bit-identical to
+        self.fuse_cross_store = fuse_cross_store
         import jax.numpy as jnp
         self.num_buckets = num_buckets
         self.initial_cap = initial_cap
         self._table = jnp.asarray(WITNESS_TABLE)
-        self._arenas: Dict[int, _NodeArena] = {}
-        self._adopted: set = set()
+        self._arenas: Dict[int, _StoreArena] = {}
+        self._encoders: Dict[int, _NodeEncoder] = {}
         self._pa_queues: Dict[int, list] = {}
         self._deps_queues: Dict[int, list] = {}
         self._ticking: set = set()
@@ -992,6 +1214,7 @@ class BatchDepsResolver(DepsResolver):
         # bench counters
         self.dispatches = 0
         self.subjects = 0
+        self.ticks = 0               # node ticks that produced any items
         self.encode_s = 0.0          # host-side upload-array build + enqueue
         self.harvest_stall_s = 0.0   # blocking on the async transfer
         self.decode_s = 0.0          # host-side result materialization
@@ -999,10 +1222,6 @@ class BatchDepsResolver(DepsResolver):
         self.polls_armed = 0         # readiness polls armed (device_poll_ms)
         self.stale_harvests = 0      # calls translated across a compaction
         self.host_fallbacks = 0      # stale calls with no pinned snapshot
-        # residual counter for the RETIRED > MAXK host_only path: the CSR
-        # encoding keeps arbitrarily wide rows on device, so this must stay
-        # 0. Kept (asserted zero in bench/tests) for one release, then drop
-        self.host_only = 0
         # subjects demoted host-side for unencodable range endpoints (never
         # hit by integer key domains)
         self.range_fallbacks = 0
@@ -1016,16 +1235,38 @@ class BatchDepsResolver(DepsResolver):
         return sum(a.upload_bytes + a.ranges.upload_bytes
                    for a in self._arenas.values())
 
+    @property
+    def upload_bytes_by_field(self) -> Dict[str, int]:
+        """upload_bytes broken out per field group: `full` rows carry every
+        lane; `keys`/`ts`/`valid` (and `range_full`/`range_valid`) are the
+        field-granular deltas."""
+        agg = {"full": 0, "keys": 0, "ts": 0, "valid": 0,
+               "range_full": 0, "range_valid": 0}
+        for a in self._arenas.values():
+            for k, v in a.upload_bytes_by_field.items():
+                agg[k] += v
+            for k, v in a.ranges.upload_bytes_by_field.items():
+                agg[k] += v
+        return agg
+
+    @property
+    def upload_bytes_full_equiv(self) -> int:
+        """Bytes the retired all-lanes scatter would have shipped for the
+        same dirty sets -- the baseline proving the granular deltas' win."""
+        return sum(a.upload_bytes_full_equiv
+                   + a.ranges.upload_bytes_full_equiv
+                   for a in self._arenas.values())
+
     # -- arena plumbing -------------------------------------------------------
-    def _arena(self, store) -> _NodeArena:
-        node = store.node
-        arena = self._arenas.get(id(node))
+    def _arena(self, store) -> _StoreArena:
+        arena = self._arenas.get(id(store))
         if arena is None:
-            arena = _NodeArena(self.num_buckets, self.initial_cap,
-                               self.range_cap)
-            self._arenas[id(node)] = arena
-        if id(store) not in self._adopted:
-            self._adopted.add(id(store))
+            enc = self._encoders.get(id(store.node))
+            if enc is None:
+                enc = self._encoders[id(store.node)] = _NodeEncoder()
+            arena = _StoreArena(self.num_buckets, self.initial_cap,
+                                self.range_cap, shared_encoder=enc)
+            self._arenas[id(store)] = arena
             # adopt anything registered before the resolver was attached
             for key, cfk in store.cfks.items():
                 for t, info in cfk._infos.items():
@@ -1048,18 +1289,18 @@ class BatchDepsResolver(DepsResolver):
             arena.ranges.update(txn_id, keys, status)
 
     def on_truncate(self, store, txn_id: TxnId) -> None:
-        arena = self._arenas.get(id(store.node))
+        arena = self._arenas.get(id(store))
         if arena is None:
             return
         row = arena.row_of.get(txn_id)
         if row is not None:
-            mine = {k for k in arena.key_sets[row]
-                    if store.slice_ranges.contains_key(k)}
-            arena.remove_keys(txn_id, mine)
-        arena.ranges.truncate(store, txn_id)
+            # the arena is per store, so every key in the row is this
+            # store's record -- no slice filtering needed anymore
+            arena.remove_keys(txn_id, arena.key_sets[row])
+        arena.ranges.truncate(txn_id)
 
     def on_prune(self, store, txn_id: TxnId, keys) -> None:
-        arena = self._arenas.get(id(store.node))
+        arena = self._arenas.get(id(store))
         if arena is not None:
             arena.remove_keys(txn_id, keys)
 
@@ -1112,57 +1353,98 @@ class BatchDepsResolver(DepsResolver):
                                store.command(t).execute_at, out, outcome))
         for (store, t, ks, before, out) in dq:
             items.append(_Item(store, t, store.owned(ks), before, out))
-        # split oversized batches so subject-bucket jit tiers stay bounded
-        # (8..max_dispatch); each slice is its own pipelined call
-        for lo in range(0, len(items), self.max_dispatch):
-            self._dispatch(node, items[lo:lo + self.max_dispatch])
+        if items:
+            self.ticks += 1
+        if self.fuse_cross_store:
+            # ONE fused device call per tick (per max_dispatch slice):
+            # every store's pending items ride together; split oversized
+            # batches so subject jit tiers stay bounded (8..max_dispatch)
+            for lo in range(0, len(items), self.max_dispatch):
+                self._dispatch(node, items[lo:lo + self.max_dispatch])
+        else:
+            # per-store dispatch: the fused path's differential baseline
+            by_store: Dict[int, List[_Item]] = {}
+            for item in items:
+                by_store.setdefault(id(item.store), []).append(item)
+            for sub in by_store.values():
+                for lo in range(0, len(sub), self.max_dispatch):
+                    self._dispatch(node, sub[lo:lo + self.max_dispatch])
 
-    def _encode_and_run(self, arena: _NodeArena, items: List[_Item]):
-        """Build the flat CSR upload arrays and run the fused kernels.
-        Shared by the async dispatch and the sync path -- the two must never
-        drift. Returns (packed, rpacked, kpacked) device arrays (each may be
-        None when that kernel had nothing to do).
+    def _encode_and_run(self, groups: List[_Group], items: List[_Item]):
+        """Build the flat CSR upload arrays and run the fused kernels for
+        one dispatch spanning one or more STORE groups. Shared by the async
+        dispatch and the sync path -- the two must never drift. Returns
+        (packed, rpacked, kpacked) device arrays (each may be None when that
+        kernel had nothing to do) and records each group's word-column spans
+        (the row-offset table) for decode routing.
 
         Key-domain subjects upload one (subject row, key bucket) CSR entry
-        per owned key -- variable width, so arbitrarily wide subjects stay on
-        the device path (the old MAXK chunking and its host_only residual are
-        retired). When range state is in play, a second CSR of half-open
-        intervals drives range_deps_resolve: key subjects as point intervals
-        (stabbing the range arena), range subjects as their owned ranges
-        (vs both arenas)."""
+        per owned key -- variable width, so arbitrarily wide subjects stay
+        on the device path. When range state is in play, a second CSR of
+        half-open intervals drives the range kernel: key subjects as point
+        intervals (stabbing their store's range arena), range subjects as
+        their owned ranges (vs both of their store's arenas). With several
+        groups, the fused kernels take every participating store's arena
+        lanes as one tuple and route subjects by the store-id lane; a single
+        group runs the plain kernels, byte-identical to the old per-store
+        path."""
         import jax.numpy as jnp
         from accord_tpu.ops.kernels import nnz_tier, subject_tier
-        ranges = arena.ranges
         n = len(items)
         b = subject_tier(n)
+        # the node-shared encoder cell: any arena with rows has set it
+        encoder = groups[0].arena.encoder
         sb = np.zeros((b, 3), dtype=np.int32)
-        sb[:n] = arena.encoder.encode_many([item.before for item in items])
+        sb[:n] = encoder.encode_many([item.before for item in items])
         sknd = np.zeros(b, dtype=np.int32)
         sknd[:n] = np.fromiter((int(item.txn_id.kind) for item in items),
                                np.int64, n)
         srng = np.zeros(b, dtype=bool)
-        key_items: List[Tuple[int, _Item]] = []
-        intervals: List[Tuple[int, int, int]] = []  # (subject, start, end)
-        need_range = False
-        for i, item in enumerate(items):
-            item.cover_seq = item.store.cover_seq
-            if isinstance(item.owned, Keys):
-                key_items.append((i, item))
-                continue
-            srng[i] = True
-            if not ranges.encode_ok:
-                item.fallback = "full"
-                self.range_fallbacks += 1
-                continue
-            ivs = encode_seekable_intervals(item.owned)
-            if ivs is None:
-                item.fallback = "full"
-                self.range_fallbacks += 1
-                continue
-            need_range = True
-            intervals.extend((i, s, e) for (s, e) in ivs)
+        # store-id lane: routes each subject to its own store's arena block
+        # inside the fused kernels; padding rows use len(groups), which no
+        # block's slot matches
+        subj_store = np.full(b, len(groups), dtype=np.int32)
+        gkeys: List[List[Tuple[int, _Item]]] = [[] for _ in groups]
+        givs: List[List[Tuple[int, int, int]]] = [[] for _ in groups]
+        ghull = [False] * len(groups)
+        for gi, g in enumerate(groups):
+            ranges = g.arena.ranges
+            for i, item in zip(g.idx, g.items):
+                subj_store[i] = gi
+                item.cover_seq = item.store.cover_seq
+                if isinstance(item.owned, Keys):
+                    gkeys[gi].append((i, item))
+                    continue
+                srng[i] = True
+                if not ranges.encode_ok:
+                    item.fallback = "full"
+                    self.range_fallbacks += 1
+                    continue
+                ivs = encode_seekable_intervals(item.owned)
+                if ivs is None:
+                    item.fallback = "full"
+                    self.range_fallbacks += 1
+                    continue
+                ghull[gi] = True
+                givs[gi].extend((i, s, e) for (s, e) in ivs)
+            if ranges.encode_ok and ranges.count > 0:
+                # key subjects stab their store's interval rows with point
+                # intervals (the retired host_range_deps union, on device)
+                for i, item in gkeys[gi]:
+                    ivs = encode_seekable_intervals(item.owned)
+                    if ivs is None:
+                        # unencodable keys: this subject's range deps come
+                        # from the host union instead (counted)
+                        item.fallback = "range"
+                        self.range_fallbacks += 1
+                        continue
+                    givs[gi].extend((i, s, e) for (s, e) in ivs)
+        # -- key-domain kernel plan --------------------------------------
         packed = None
-        if arena.count > 0 and key_items:
+        k_parts = [(gi, g) for gi, g in enumerate(groups)
+                   if g.arena.count > 0 and gkeys[gi]]
+        if k_parts:
+            key_items = [pair for gi, _ in k_parts for pair in gkeys[gi]]
             counts = np.fromiter((len(item.owned) for _, item in key_items),
                                  np.int64, len(key_items))
             total = int(counts.sum())
@@ -1178,24 +1460,34 @@ class BatchDepsResolver(DepsResolver):
                 subj_keys[:total] = (np.fromiter(
                     (int(k) for _, item in key_items for k in item.owned),
                     np.int64, total) % self.num_buckets).astype(np.int32)
-            packed = self._run_kernel(
-                arena, jnp.asarray(subj_of), jnp.asarray(subj_keys),
-                jnp.asarray(sb), jnp.asarray(sknd))
-        if ranges.encode_ok and ranges.count > 0:
-            # key subjects stab the interval rows with point intervals (the
-            # retired host_range_deps union, on device)
-            for i, item in key_items:
-                ivs = encode_seekable_intervals(item.owned)
-                if ivs is None:
-                    # unencodable keys: this subject's range deps come from
-                    # the host union instead (counted)
-                    item.fallback = "range"
-                    self.range_fallbacks += 1
-                    continue
-                need_range = True
-                intervals.extend((i, s, e) for (s, e) in ivs)
+            if len(groups) == 1:
+                g = groups[0]
+                packed = self._run_kernel(
+                    g.arena, jnp.asarray(subj_of), jnp.asarray(subj_keys),
+                    jnp.asarray(sb), jnp.asarray(sknd))
+                g.pk = (0, g.arena.cap // 32)
+            else:
+                slots = np.fromiter((gi for gi, _ in k_parts), np.int64,
+                                    len(k_parts)).astype(np.int32)
+                packed = self._run_fused_kernel(
+                    [g for _, g in k_parts], jnp.asarray(slots),
+                    jnp.asarray(subj_of), jnp.asarray(subj_keys),
+                    jnp.asarray(subj_store), jnp.asarray(sb),
+                    jnp.asarray(sknd))
+                off = 0
+                for _, g in k_parts:
+                    w = g.arena.cap // 32
+                    g.pk = (off, off + w)
+                    off += w
+        # -- range kernel plan -------------------------------------------
         rpacked = kpacked = None
-        if need_range and intervals:
+        intervals = [t for gv in givs for t in gv]
+        r_parts = [(gi, g) for gi, g in enumerate(groups)
+                   if g.arena.ranges.count > 0 and g.arena.ranges.encode_ok
+                   and givs[gi]]
+        h_parts = [(gi, g) for gi, g in enumerate(groups)
+                   if ghull[gi] and g.arena.count > 0]
+        if intervals and (len(groups) == 1 or r_parts or h_parts):
             nv = nnz_tier(len(intervals))
             iv_of = np.full(nv, b, dtype=np.int32)
             iv_s = np.zeros(nv, dtype=np.int32)
@@ -1204,21 +1496,53 @@ class BatchDepsResolver(DepsResolver):
             iv_of[:len(intervals)] = arr[:, 0]
             iv_s[:len(intervals)] = arr[:, 1]
             iv_e[:len(intervals)] = arr[:, 2]
-            rpacked, kpacked = self._run_range_kernel(
-                arena, jnp.asarray(iv_of), jnp.asarray(iv_s),
-                jnp.asarray(iv_e), jnp.asarray(sb), jnp.asarray(sknd),
-                jnp.asarray(srng))
+            if len(groups) == 1:
+                g = groups[0]
+                rpacked, kpacked = self._run_range_kernel(
+                    g.arena, jnp.asarray(iv_of), jnp.asarray(iv_s),
+                    jnp.asarray(iv_e), jnp.asarray(sb), jnp.asarray(sknd),
+                    jnp.asarray(srng))
+                g.rp = (0, g.arena.ranges.cap // 32)
+                g.kp = (0, g.arena.cap // 32)
+            else:
+                r_slots = np.fromiter((gi for gi, _ in r_parts), np.int64,
+                                      len(r_parts)).astype(np.int32)
+                k_slots = np.fromiter((gi for gi, _ in h_parts), np.int64,
+                                      len(h_parts)).astype(np.int32)
+                rpacked, kpacked = self._run_fused_range_kernel(
+                    [g for _, g in r_parts], jnp.asarray(r_slots),
+                    [g for _, g in h_parts], jnp.asarray(k_slots),
+                    jnp.asarray(iv_of), jnp.asarray(iv_s),
+                    jnp.asarray(iv_e), jnp.asarray(subj_store),
+                    jnp.asarray(sb), jnp.asarray(sknd), jnp.asarray(srng))
+                if r_parts:
+                    off = 0
+                    for _, g in r_parts:
+                        w = g.arena.ranges.cap // 32
+                        g.rp = (off, off + w)
+                        off += w
+                else:
+                    rpacked = None
+                if h_parts:
+                    off = 0
+                    for _, g in h_parts:
+                        w = g.arena.cap // 32
+                        g.kp = (off, off + w)
+                        off += w
+                else:
+                    kpacked = None
         return packed, rpacked, kpacked
 
-    def _run_kernel(self, arena: "_NodeArena", subj_of, subj_keys, sb, sknd):
-        """The fused kernel call; ShardedBatchDepsResolver overrides this to
-        run the same computation sharded over a device mesh."""
+    def _run_kernel(self, arena: "_StoreArena", subj_of, subj_keys, sb,
+                    sknd):
+        """The single-store kernel call; ShardedBatchDepsResolver overrides
+        this to run the same computation sharded over a device mesh."""
         from accord_tpu.ops.kernels import deps_resolve
         act_bm, act_ts, _, act_kinds, _, _, act_valid = arena.device_arrays()
         return deps_resolve(subj_of, subj_keys, sb, sknd,
                             act_bm, act_ts, act_kinds, act_valid, self._table)
 
-    def _run_range_kernel(self, arena: "_NodeArena", iv_of, iv_s, iv_e,
+    def _run_range_kernel(self, arena: "_StoreArena", iv_of, iv_s, iv_e,
                           sb, sknd, srng):
         from accord_tpu.ops.kernels import range_deps_resolve
         r_start, r_end, r_ts, r_kinds, r_valid = \
@@ -1229,7 +1553,34 @@ class BatchDepsResolver(DepsResolver):
                                   k_kmin, k_kmax, k_ts, k_kinds, k_valid,
                                   self._table)
 
-    def _decode_batch(self, arena: _NodeArena, items: List[_Item],
+    def _run_fused_kernel(self, kgroups: List[_Group], slots, subj_of,
+                          subj_keys, subj_store, sb, sknd):
+        """The fused cross-store key kernel: every participating store's
+        arena lanes enter one call as a tuple block; ShardedBatchDepsResolver
+        overrides this to run it over the mesh."""
+        from accord_tpu.ops.kernels import fused_deps_resolve
+        arenas = []
+        for g in kgroups:
+            bm, ts, _, kinds, _, _, valid = g.arena.device_arrays()
+            arenas.append((bm, ts, kinds, valid))
+        return fused_deps_resolve(subj_of, subj_keys, subj_store, sb, sknd,
+                                  slots, tuple(arenas), self._table)
+
+    def _run_fused_range_kernel(self, rgroups: List[_Group], r_slots,
+                                kgroups: List[_Group], k_slots,
+                                iv_of, iv_s, iv_e, subj_store, sb, sknd,
+                                srng):
+        from accord_tpu.ops.kernels import fused_range_deps_resolve
+        rarenas = tuple(g.arena.ranges.device_arrays() for g in rgroups)
+        karenas = []
+        for g in kgroups:
+            _, ts, _, kinds, kmin, kmax, valid = g.arena.device_arrays()
+            karenas.append((kmin, kmax, ts, kinds, valid))
+        return fused_range_deps_resolve(iv_of, iv_s, iv_e, subj_store, sb,
+                                        sknd, srng, r_slots, rarenas,
+                                        k_slots, tuple(karenas), self._table)
+
+    def _decode_batch(self, arena: _StoreArena, items: List[_Item],
                       packed: np.ndarray) -> list:
         """Recover every item's exact key-domain deps from the dispatch-wide
         bit-packed kernel result in one vectorized pass -> [KeyDeps].
@@ -1363,8 +1714,8 @@ class BatchDepsResolver(DepsResolver):
                              tuple(inv.tolist()))
         return out
 
-    def _decode_key_range_deps(self, arena: _NodeArena, call: _Call,
-                               i: int, item: _Item):
+    def _decode_key_range_deps(self, arena: _StoreArena, rgen: int,
+                               rprow: np.ndarray, item: _Item):
         """Range-txn deps of a KEY subject, recovered from the range
         kernel's candidate rows -- the device replacement for the retired
         host_range_deps union. Exact: per-key containment against the
@@ -1372,8 +1723,8 @@ class BatchDepsResolver(DepsResolver):
         (cross-store rows, freed-row reuse, retired generations), and the
         before/witness masks are re-verified host-side. None when a stale
         call has no pinned snapshot (caller falls back; counted)."""
-        rows = _unpack_row(call.np_rpacked[i])
-        cand = arena.ranges.candidate_ids(call.rgen, rows)
+        rows = _unpack_row(rprow)
+        cand = arena.ranges.candidate_ids(rgen, rows)
         if cand is None:
             return None
         kb = KeyDepsBuilder()
@@ -1391,20 +1742,23 @@ class BatchDepsResolver(DepsResolver):
                     kb.add(k, rid)
         return kb.build()
 
-    def _decode_range_subject(self, arena: _NodeArena, call: _Call,
-                              i: int, item: _Item) -> Optional[Deps]:
-        """A RANGE subject's full Deps from the two candidate buffers:
-        range-vs-range from the interval arena (re-sliced per store against
-        range_txns), range-vs-key from the key arena's span hull (re-filtered
-        per real key, with the host scan's covered-elision and invalidation
-        rules). None -> no usable snapshot (caller falls back; counted)."""
+    def _decode_range_subject(self, arena: _StoreArena, g: _Group,
+                              rprow: Optional[np.ndarray],
+                              kprow: Optional[np.ndarray],
+                              item: _Item) -> Optional[Deps]:
+        """A RANGE subject's full Deps from its group's slices of the two
+        candidate buffers: range-vs-range from the interval arena (re-sliced
+        against the store's range_txns), range-vs-key from the key arena's
+        span hull (re-filtered per real key, with the host scan's
+        covered-elision and invalidation rules). None -> no usable snapshot
+        (caller falls back; counted)."""
         from accord_tpu.primitives.deps import KeyDeps
         store = item.store
         kind = item.txn_id.kind
         rb = RangeDepsBuilder()
-        if call.np_rpacked is not None:
-            rows = _unpack_row(call.np_rpacked[i])
-            cand = arena.ranges.candidate_ids(call.rgen, rows)
+        if rprow is not None:
+            rows = _unpack_row(rprow)
+            cand = arena.ranges.candidate_ids(g.rgen, rows)
             if cand is None:
                 return None
             rt = store.range_txns
@@ -1415,10 +1769,10 @@ class BatchDepsResolver(DepsResolver):
                     continue
                 for r in rt[rid].intersection(item.owned):
                     rb.add(r, rid)
-        if call.np_kpacked is not None:
-            krows = _unpack_row(call.np_kpacked[i])
-            if call.gen != arena.gen:
-                krows = arena.translate_rows(call.gen, krows)
+        if kprow is not None:
+            krows = _unpack_row(kprow)
+            if g.gen != arena.gen:
+                krows = arena.translate_rows(g.gen, krows)
                 if krows is None:
                     return None
             cfks = store.cfks
@@ -1448,68 +1802,80 @@ class BatchDepsResolver(DepsResolver):
     def _decode_core(self, call: _Call) -> List[Deps]:
         """Decode a harvested call -> raw Deps per item (no floor injection
         -- sync callers' floors are injected by store.calculate_deps; the
-        async harvest wraps this with _decode_dispatch). Handles same-gen
-        and stale (compacted mid-flight) calls uniformly: key-domain rows
-        translate through the pinned row snapshot, range candidates
-        translate by txn id. Falls back to the host scan only when no
-        snapshot survived (counted; not expected)."""
+        async harvest wraps this with _decode_dispatch). Each _Group slices
+        its word-column span out of the fused buffers (the row-offset table
+        in action) and decodes against its own store's arena. Handles
+        same-gen and stale (compacted mid-flight) groups uniformly:
+        key-domain rows translate through the pinned row snapshot, range
+        candidates translate by txn id. Falls back to the host scan only
+        when no snapshot survived (counted; not expected)."""
         from accord_tpu.primitives.deps import KeyDeps
-        arena = call.arena
-        items = call.items
-        key_stale = call.np_packed is not None and call.gen != arena.gen
-        kds = None
-        if call.np_packed is not None and not key_stale:
-            kds = self._decode_batch(arena, items, call.np_packed)
-        results: List[Deps] = []
-        for i, item in enumerate(items):
-            store = item.store
-            if item.fallback == "full":
-                results.append(store.host_calculate_deps(
-                    item.txn_id, item.owned, item.before))
-                continue
-            if not isinstance(item.owned, Keys):
-                if not arena.ranges.encode_ok:
-                    # reached only via the empty-call path (encode sets
-                    # fallback="full" otherwise): unencodable node state
-                    self.range_fallbacks += 1
-                    results.append(store.host_calculate_deps(
-                        item.txn_id, item.owned, item.before))
+        results: List[Optional[Deps]] = [None] * len(call.items)
+        for g in call.groups:
+            arena = g.arena
+            idx = np.asarray(g.idx, np.int64)
+            gp = call.np_packed[idx][:, g.pk[0]:g.pk[1]] \
+                if call.np_packed is not None and g.pk is not None else None
+            grp = call.np_rpacked[idx][:, g.rp[0]:g.rp[1]] \
+                if call.np_rpacked is not None and g.rp is not None else None
+            gkp = call.np_kpacked[idx][:, g.kp[0]:g.kp[1]] \
+                if call.np_kpacked is not None and g.kp is not None else None
+            key_stale = gp is not None and g.gen != arena.gen
+            kds = None
+            if gp is not None and not key_stale:
+                kds = self._decode_batch(arena, g.items, gp)
+            for j, item in enumerate(g.items):
+                store = item.store
+                if item.fallback == "full":
+                    results[g.idx[j]] = store.host_calculate_deps(
+                        item.txn_id, item.owned, item.before)
                     continue
-                d = self._decode_range_subject(arena, call, i, item)
-                if d is None:
-                    self.host_fallbacks += 1
-                    d = store.host_calculate_deps(item.txn_id, item.owned,
-                                                  item.before)
-                results.append(d)
-                continue
-            if kds is not None:
-                kd = kds[i]
-            elif key_stale:
-                rows = arena.translate_rows(
-                    call.gen, _unpack_row(call.np_packed[i]))
-                if rows is None:
-                    self.host_fallbacks += 1
-                    results.append(store.host_calculate_deps(
-                        item.txn_id, item.owned, item.before))
+                if not isinstance(item.owned, Keys):
+                    if not arena.ranges.encode_ok:
+                        # reached only via the no-buffer path (encode sets
+                        # fallback="full" otherwise): unencodable state
+                        self.range_fallbacks += 1
+                        results[g.idx[j]] = store.host_calculate_deps(
+                            item.txn_id, item.owned, item.before)
+                        continue
+                    d = self._decode_range_subject(
+                        arena, g, grp[j] if grp is not None else None,
+                        gkp[j] if gkp is not None else None, item)
+                    if d is None:
+                        self.host_fallbacks += 1
+                        d = store.host_calculate_deps(
+                            item.txn_id, item.owned, item.before)
+                    results[g.idx[j]] = d
                     continue
-                kd = arena.decode_rows(item.txn_id, item.owned, rows,
-                                       store, item.before, item.cover_seq)
-            else:
-                kd = KeyDeps.EMPTY
-            deps = Deps(kd)
-            if item.fallback == "range" or not arena.ranges.encode_ok:
-                if store.range_txns:
-                    deps = deps.union(store.host_range_deps(
-                        item.txn_id, item.owned, item.before))
-            elif call.np_rpacked is not None:
-                extra = self._decode_key_range_deps(arena, call, i, item)
-                if extra is None:
-                    self.host_fallbacks += 1
-                    deps = deps.union(store.host_range_deps(
-                        item.txn_id, item.owned, item.before))
-                elif not extra.is_empty():
-                    deps = deps.union(Deps(extra))
-            results.append(deps)
+                if kds is not None:
+                    kd = kds[j]
+                elif key_stale:
+                    rows = arena.translate_rows(g.gen, _unpack_row(gp[j]))
+                    if rows is None:
+                        self.host_fallbacks += 1
+                        results[g.idx[j]] = store.host_calculate_deps(
+                            item.txn_id, item.owned, item.before)
+                        continue
+                    kd = arena.decode_rows(item.txn_id, item.owned, rows,
+                                           store, item.before,
+                                           item.cover_seq)
+                else:
+                    kd = KeyDeps.EMPTY
+                deps = Deps(kd)
+                if item.fallback == "range" or not arena.ranges.encode_ok:
+                    if store.range_txns:
+                        deps = deps.union(store.host_range_deps(
+                            item.txn_id, item.owned, item.before))
+                elif grp is not None:
+                    extra = self._decode_key_range_deps(arena, g.rgen,
+                                                        grp[j], item)
+                    if extra is None:
+                        self.host_fallbacks += 1
+                        deps = deps.union(store.host_range_deps(
+                            item.txn_id, item.owned, item.before))
+                    elif not extra.is_empty():
+                        deps = deps.union(Deps(extra))
+                results[g.idx[j]] = deps
         return results
 
     def _decode_dispatch(self, call: _Call) -> List[Deps]:
@@ -1521,29 +1887,43 @@ class BatchDepsResolver(DepsResolver):
 
     def _dispatch(self, node, items: List[_Item]) -> None:
         import time as _time
+        # ensure adoption of late-attached stores BEFORE snapshotting group
+        # generations -- adoption may mutate (and compact) an arena
         for item in items:
-            self._arena(item.store)  # ensure adoption of late-attached stores
-        arena = self._arenas.get(id(node))
-        if arena is None or (arena.count == 0 and arena.ranges.count == 0):
+            self._arena(item.store)
+        groups_by: Dict[int, _Group] = {}
+        groups: List[_Group] = []
+        for i, item in enumerate(items):
+            g = groups_by.get(id(item.store))
+            if g is None:
+                g = groups_by[id(item.store)] = \
+                    _Group(item.store, self._arenas[id(item.store)])
+                groups.append(g)
+            g.idx.append(i)
+            g.items.append(item)
+        if all(g.arena.count == 0 and g.arena.ranges.count == 0
+               for g in groups):
             # nothing on device to conflict with (and possibly no encoder
             # yet): an empty call still flows through the pipeline so floors
             # and fallbacks are injected at harvest
-            call = _Call(None, None, None, items,
-                         arena or _NodeArena(self.num_buckets, 8))
+            call = _Call(None, None, None, items, groups)
         else:
             t0 = _time.perf_counter()
-            packed, rpacked, kpacked = self._encode_and_run(arena, items)
+            packed, rpacked, kpacked = self._encode_and_run(groups, items)
             for buf in (packed, rpacked, kpacked):
                 if buf is not None:
                     buf.copy_to_host_async()
             self.encode_s += _time.perf_counter() - t0
-            call = _Call(packed, rpacked, kpacked, items, arena)
-            # matched by unpin_gen in _harvest; kpacked rows address the KEY
-            # arena, so either key-domain buffer pins the key snapshot
-            if packed is not None or kpacked is not None:
-                arena.pin_gen()
-            if rpacked is not None:
-                arena.ranges.pin_gen()
+            call = _Call(packed, rpacked, kpacked, items, groups)
+            # matched by unpin_gen in _harvest; kp spans address the KEY
+            # arena, so either key-domain span pins the key snapshot
+            for g in groups:
+                if g.pk is not None or g.kp is not None:
+                    g.arena.pin_gen()
+                    g.pinned = True
+                if g.rp is not None:
+                    g.arena.ranges.pin_gen()
+                    g.rpinned = True
         self.dispatches += 1
         self.subjects += len(items)
         self._inflight.setdefault(id(node), deque()).append(call)
@@ -1595,7 +1975,6 @@ class BatchDepsResolver(DepsResolver):
         if not q:
             return  # defensive: every dispatch schedules exactly one harvest
         call = q.popleft()
-        arena = call.arena
         if call.has_device:
             t0 = _time.perf_counter()
             if call.fetch():
@@ -1603,15 +1982,16 @@ class BatchDepsResolver(DepsResolver):
             else:
                 self.prefetched += 1
         t0 = _time.perf_counter()
-        if (call.packed is not None and call.gen != arena.gen) \
-                or (call.rpacked is not None
-                    and call.rgen != arena.ranges.gen):
+        if any((g.pk is not None and g.gen != g.arena.gen)
+               or (g.rp is not None and g.rgen != g.arena.ranges.gen)
+               for g in call.groups):
             self.stale_harvests += 1
         results = self._decode_dispatch(call)
-        if call.packed is not None or call.kpacked is not None:
-            arena.unpin_gen(call.gen)
-        if call.rpacked is not None:
-            arena.ranges.unpin_gen(call.rgen)
+        for g in call.groups:
+            if g.pinned:
+                g.arena.unpin_gen(g.gen)
+            if g.rpinned:
+                g.arena.ranges.unpin_gen(g.rgen)
         self.decode_s += _time.perf_counter() - t0
         for item, deps in zip(call.items, results):
             if item.outcome is not None:
@@ -1621,9 +2001,9 @@ class BatchDepsResolver(DepsResolver):
 
     # -- synchronous SPI (tests, rare recovery-path callers) ------------------
     def resolve_one(self, store, txn_id, seekables, before) -> Deps:
-        arena = self._arenas.get(id(store.node))
-        if arena is not None and arena.encoder is not None \
-                and not arena.encoder.in_window(before):
+        enc = self._encoders.get(id(store.node))
+        if enc is not None and enc.encoder is not None \
+                and not enc.encoder.in_window(before):
             # e.g. Timestamp.MAX (ephemeral reads bound by "everything"):
             # unencodable on device -- the host scan answers
             return store.host_calculate_deps(txn_id, seekables, before)
@@ -1639,11 +2019,14 @@ class BatchDepsResolver(DepsResolver):
         arena = self._arena(store)
         items = [_Item(store, t, owned, before, None)
                  for (t, owned, before) in subjects]
+        g = _Group(store, arena)
+        g.idx = list(range(len(items)))
+        g.items = items
         if arena.count == 0 and arena.ranges.count == 0:
-            call = _Call(None, None, None, items, arena)
+            call = _Call(None, None, None, items, [g])
         else:
-            packed, rpacked, kpacked = self._encode_and_run(arena, items)
-            call = _Call(packed, rpacked, kpacked, items, arena)
+            packed, rpacked, kpacked = self._encode_and_run([g], items)
+            call = _Call(packed, rpacked, kpacked, items, [g])
             call.fetch()
         return self._decode_core(call)
 
@@ -1657,7 +2040,7 @@ class BatchDepsResolver(DepsResolver):
             # MaxConflicts map inside the tick -- a synchronous device call
             # here would serialize the pipeline on the tunnel round trip
             return False, None
-        arena = self._arenas.get(id(store.node))
+        arena = self._arenas.get(id(store))
         if arena is not None and arena.had_truncation:
             # truncation shrinks bitmap rows, so the (monotone) device
             # max-conflict could understate -- the host decides. (The old
@@ -1720,8 +2103,9 @@ class ShardedBatchDepsResolver(BatchDepsResolver):
     the arrays LIVE sharded and the per-call movement is dirty rows only."""
 
     def __init__(self, mesh=None, num_buckets: int = 256,
-                 initial_cap: int = 4096):
-        super().__init__(num_buckets, initial_cap)
+                 initial_cap: int = 4096, fuse_cross_store: bool = True):
+        super().__init__(num_buckets, initial_cap,
+                         fuse_cross_store=fuse_cross_store)
         from accord_tpu.parallel.mesh import make_mesh
         self.mesh = mesh if mesh is not None else make_mesh()
         data = self.mesh.shape["data"]
@@ -1733,11 +2117,19 @@ class ShardedBatchDepsResolver(BatchDepsResolver):
         Invariants.check_argument(
             num_buckets % model == 0,
             "num_buckets %s not divisible by model(%s)", num_buckets, model)
+        # the sharded range kernel contracts the key-arena hull test over
+        # 'model' buckets with int32 modular arithmetic, exact only when
+        # the bucket count divides 2^32
+        Invariants.check_argument(
+            num_buckets & (num_buckets - 1) == 0,
+            "num_buckets %s not a power of two (the sharded bucket "
+            "contraction's int32 modular hull test requires it)",
+            num_buckets)
         # the range arena shards its rows over 'data' too, so its capacity
         # must honor the same 32*data packing contract (GROW=2 preserves it)
         self.range_cap = max(64, 32 * data)
 
-    def _run_kernel(self, arena: _NodeArena, subj_of, subj_keys, sb, sknd):
+    def _run_kernel(self, arena: _StoreArena, subj_of, subj_keys, sb, sknd):
         # sharded_deps_resolve is lru_cached by mesh: every resolver (one
         # per node in a burn) shares one compiled kernel
         from accord_tpu.parallel.mesh import sharded_deps_resolve
@@ -1746,13 +2138,49 @@ class ShardedBatchDepsResolver(BatchDepsResolver):
         return kern(subj_of, subj_keys, sb, sknd,
                     act_bm, act_ts, act_kinds, act_valid, self._table)
 
-    def _run_range_kernel(self, arena: _NodeArena, iv_of, iv_s, iv_e,
+    def _run_range_kernel(self, arena: _StoreArena, iv_of, iv_s, iv_e,
                           sb, sknd, srng):
+        # the key-side hull test runs bucket-contracted over 'model': the
+        # subject intervals scatter into local bucket coverage and the key
+        # bitmap contracts against it, so the kmin/kmax row lanes never
+        # replicate across the mesh (host decode re-filters per real key,
+        # so the conservative coverage superset stays exact end to end)
         from accord_tpu.parallel.mesh import sharded_range_deps_resolve
         kern = sharded_range_deps_resolve(self.mesh)
         r_start, r_end, r_ts, r_kinds, r_valid = \
             arena.ranges.device_arrays()
-        _, k_ts, _, k_kinds, k_kmin, k_kmax, k_valid = arena.device_arrays()
+        act_bm, k_ts, _, k_kinds, _, _, k_valid = arena.device_arrays()
         return kern(iv_of, iv_s, iv_e, sb, sknd, srng,
                     r_start, r_end, r_ts, r_kinds, r_valid,
-                    k_kmin, k_kmax, k_ts, k_kinds, k_valid, self._table)
+                    act_bm, k_ts, k_kinds, k_valid, self._table)
+
+    def _run_fused_kernel(self, kgroups: List[_Group], slots, subj_of,
+                          subj_keys, subj_store, sb, sknd):
+        # lru_cached by (mesh, store count): all same-width fused dispatches
+        # share one compiled kernel
+        from accord_tpu.parallel.mesh import sharded_fused_deps_resolve
+        kern = sharded_fused_deps_resolve(self.mesh, len(kgroups))
+        arenas = []
+        for g in kgroups:
+            bm, ts, _, kinds, _, _, valid = g.arena.device_arrays()
+            arenas.append((bm, ts, kinds, valid))
+        return kern(subj_of, subj_keys, subj_store, sb, sknd,
+                    slots, tuple(arenas), self._table)
+
+    def _run_fused_range_kernel(self, rgroups: List[_Group], r_slots,
+                                kgroups: List[_Group], k_slots,
+                                iv_of, iv_s, iv_e, subj_store, sb, sknd,
+                                srng):
+        # the sharded fused karena lane set deliberately differs from the
+        # single-device one: (bm, ts, kinds, valid) for the bucket-contracted
+        # hull test instead of the replicated (kmin, kmax, ...) hull lanes
+        from accord_tpu.parallel.mesh import sharded_fused_range_deps_resolve
+        kern = sharded_fused_range_deps_resolve(self.mesh, len(rgroups),
+                                                len(kgroups))
+        rarenas = tuple(g.arena.ranges.device_arrays() for g in rgroups)
+        karenas = []
+        for g in kgroups:
+            bm, ts, _, kinds, _, _, valid = g.arena.device_arrays()
+            karenas.append((bm, ts, kinds, valid))
+        return kern(iv_of, iv_s, iv_e, subj_store, sb, sknd, srng,
+                    r_slots, rarenas, k_slots, tuple(karenas), self._table)

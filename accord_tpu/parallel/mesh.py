@@ -178,34 +178,81 @@ def sharded_deps_resolve(mesh: Mesh):
         rep2), out_shardings=NamedSharding(mesh, P(None, "data")))
 
 
+def _concat_lane_blocks(mesh: Mesh, blocks):
+    """Concatenate per-store packed blocks along the lane axis. The blocks
+    come out of the fused kernels sharded P(None, 'data'); on this jax
+    version, concatenating along a 'data'-sharded axis on a 2D mesh with a
+    >1 'model' axis miscompiles -- the model-replicated lanes are summed as
+    if they were partial results, doubling every packed word. Resharding to
+    fully replicated first makes the concat collective-free and correct
+    (the blocks are a few KB, so the replication copy is noise)."""
+    if len(blocks) == 1:
+        return blocks[0]
+    rep = NamedSharding(mesh, P(None, None))
+    return jnp.concatenate([jax.device_put(blk, rep) for blk in blocks],
+                           axis=1)
+
+
+def _covered_buckets(iv_of, iv_start, iv_end, b, k_local, model):
+    """The subject intervals' bucket-coverage bitmap, restricted to THIS
+    'model' shard's bucket slice -> bf16[b, k_local]. A half-open interval
+    [s, e) of raw key tokens covers bucket j iff some v in [s, e) has
+    v mod K == j, i.e. (j - s) mod K < e - s. int32 subtraction wraps mod
+    2^32, which preserves residues mod K exactly when K divides 2^32 -- the
+    resolver asserts num_buckets is a power of two. Widths that overflow
+    int32 go negative (true width < 2^32 always), so `wide` catches both
+    them and genuinely-full intervals; coverage is a conservative superset
+    either way (the host decode re-filters per real key)."""
+    k_total = k_local * model
+    j = jax.lax.axis_index("model") * k_local \
+        + jnp.arange(k_local, dtype=jnp.int32)
+    width = iv_end - iv_start
+    wide = (width <= 0) | (width >= k_total)
+    covered = wide[:, None] | (
+        jnp.mod(j[None, :] - iv_start[:, None], k_total) < width[:, None])
+    # padding entries (iv_of == b) drop out of the scatter
+    return jnp.zeros((b, k_local), jnp.float32) \
+        .at[iv_of].max(covered.astype(jnp.float32), mode="drop") \
+        .astype(jnp.bfloat16)
+
+
 @functools.lru_cache(maxsize=8)
 def sharded_range_deps_resolve(mesh: Mesh):
-    """Mesh-sharded twin of ops.kernels.range_deps_resolve: range-arena rows
-    AND key-arena rows shard over 'data' only (the interval compares have no
-    bucket dimension to contract, so 'model' lanes just replicate the tiny
-    subject CSR and each compute their data block). Both packed outputs come
-    back lane-sharded over 'data'; lane order equals row order because
-    rcap % (32 * data) == 0 and cap % (32 * data) == 0 (the resolver's
-    capacity contracts, preserved by doubling)."""
+    """Mesh-sharded twin of ops.kernels.range_deps_resolve. Range-arena rows
+    shard over 'data' (the interval compares have no bucket dimension, so
+    'model' lanes replicate the tiny subject CSR and each compute their data
+    block). The key-side test CONTRACTS over 'model' buckets like
+    sharded_deps_resolve: the subject intervals scatter into per-shard
+    bucket coverage (_covered_buckets) and contract against the key bitmap
+    [cap, K] sharded ('data', 'model'), replacing the single-device kmin/
+    kmax hull lanes -- no key-arena row lane is replicated across 'model'.
+    Both packed outputs come back lane-sharded over 'data'; lane order
+    equals row order because rcap % (32 * data) == 0 and
+    cap % (32 * data) == 0 (the resolver's capacity contracts, preserved by
+    doubling). Bucket coverage and the hull are both conservative supersets
+    of the true key overlap; the host decode re-filters per real key, so
+    single-device and sharded answers stay differentially identical."""
     from accord_tpu.ops.kernels import _lex_before, _pack_bits
+    model = mesh.shape["model"]
 
     def run(iv_of, iv_start, iv_end, subj_before, subj_kinds, subj_is_range,
             r_start, r_end, r_ts, r_kinds, r_valid,
-            k_kmin, k_kmax, k_ts, k_kinds, k_valid, table):
+            act_bm, k_ts, k_kinds, k_valid, table):
         def part(ivo, ivs, ive, sb, sknd, srng,
-                 rs, re_, rts, rkd, rvl, kmn, kmx, kts, kknd, kvl, tbl):
+                 rs, re_, rts, rkd, rvl, bm, kts, kknd, kvl, tbl):
             b = sb.shape[0]
             rcap_l = rs.shape[0]
-            cap_l = kmn.shape[0]
             hit_r = (ivs[:, None] < re_[None, :]) & (rs[None, :] < ive[:, None])
             any_r = jnp.zeros((b, rcap_l), jnp.int32) \
                 .at[ivo].max(hit_r.astype(jnp.int32), mode="drop") > 0
             witness_r = tbl[sknd[:, None], rkd[None, :]] == 1
             before_r = _lex_before(rts[None, :, :], sb[:, None, :])
             m_r = any_r & witness_r & before_r & rvl[None, :]
-            hit_k = (ivs[:, None] <= kmx[None, :]) & (kmn[None, :] < ive[:, None])
-            any_k = jnp.zeros((b, cap_l), jnp.int32) \
-                .at[ivo].max(hit_k.astype(jnp.int32), mode="drop") > 0
+            cov = _covered_buckets(ivo, ivs, ive, b, bm.shape[1], model)
+            partial = jax.lax.dot_general(
+                cov, bm.astype(jnp.bfloat16),
+                (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+            any_k = jax.lax.psum(partial, "model") > 0.5
             witness_k = tbl[sknd[:, None], kknd[None, :]] == 1
             before_k = _lex_before(kts[None, :, :], sb[:, None, :])
             m_k = any_k & witness_k & before_k & kvl[None, :] & srng[:, None]
@@ -217,12 +264,12 @@ def sharded_range_deps_resolve(mesh: Mesh):
                       P(None),
                       P("data"), P("data"), P("data", None), P("data"),
                       P("data"),
-                      P("data"), P("data"), P("data", None), P("data"),
+                      P("data", "model"), P("data", None), P("data"),
                       P("data"), P(None, None)),
             out_specs=(P(None, "data"), P(None, "data")),
         )(iv_of, iv_start, iv_end, subj_before, subj_kinds, subj_is_range,
           r_start, r_end, r_ts, r_kinds, r_valid,
-          k_kmin, k_kmax, k_ts, k_kinds, k_valid, table)
+          act_bm, k_ts, k_kinds, k_valid, table)
 
     rep2 = NamedSharding(mesh, P(None, None))
     rep1 = NamedSharding(mesh, P(None))
@@ -232,19 +279,163 @@ def sharded_range_deps_resolve(mesh: Mesh):
     return jax.jit(run, in_shardings=(
         rep1, rep1, rep1, rep2, rep1, rep1,
         d1, d1, d2, d1, d1,
-        d1, d1, d2, d1, d1, rep2), out_shardings=(out, out))
+        NamedSharding(mesh, P("data", "model")), d2, d1, d1,
+        rep2), out_shardings=(out, out))
+
+
+@functools.lru_cache(maxsize=32)
+def sharded_fused_deps_resolve(mesh: Mesh, nstores: int):
+    """Mesh-sharded twin of ops.kernels.fused_deps_resolve: one call
+    resolves subjects against NSTORES arenas, each sharded like
+    sharded_deps_resolve (rows over 'data', buckets over 'model'). The
+    subject bitmap is built once per shard; each arena block applies its
+    store's slot mask and packs its own lane block, and the per-store
+    blocks concatenate OUTSIDE the shard_map (inside, the 'data'-sharded
+    lane axes would interleave across stores) -- and outside the jit, via
+    _concat_lane_blocks (see its docstring for the sharded-axis concat
+    miscompile it routes around). lru_cached by (mesh, store count) so
+    same-width dispatches share one compiled kernel."""
+    from accord_tpu.ops.kernels import _lex_before, _pack_bits
+
+    def run(subj_of, subj_keys, subj_store, subj_before, subj_kinds,
+            slots, arenas, table):
+        def part(sof, sk, sst, sb, sknd, sl, ars, tbl):
+            b = sb.shape[0]
+            k_local = ars[0][0].shape[1]
+            base = jax.lax.axis_index("model") * k_local
+            col = sk - base
+            col = jnp.where((col >= 0) & (col < k_local), col, k_local)
+            subj_bm = jnp.zeros((b, k_local), jnp.float32) \
+                .at[sof, col].max(1.0, mode="drop").astype(jnp.bfloat16)
+            outs = []
+            for s in range(nstores):
+                bm, ts, kinds, valid = ars[s]
+                partial = jax.lax.dot_general(
+                    subj_bm, bm.astype(jnp.bfloat16),
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                overlap = jax.lax.psum(partial, "model") > 0.5
+                witness = tbl[sknd[:, None], kinds[None, :]] == 1
+                before = _lex_before(ts[None, :, :], sb[:, None, :])
+                mine = (sst == sl[s])[:, None]
+                outs.append(_pack_bits(
+                    overlap & witness & before & valid[None, :] & mine))
+            return tuple(outs)
+
+        arena_specs = tuple(
+            (P("data", "model"), P("data", None), P("data"), P("data"))
+            for _ in range(nstores))
+        return shard_map(
+            part, mesh=mesh,
+            in_specs=(P(None), P(None), P(None), P(None, None), P(None),
+                      P(None), arena_specs, P(None, None)),
+            out_specs=tuple(P(None, "data") for _ in range(nstores)),
+        )(subj_of, subj_keys, subj_store, subj_before, subj_kinds,
+          slots, arenas, table)
+
+    jitted = jax.jit(run)
+
+    def call(subj_of, subj_keys, subj_store, subj_before, subj_kinds,
+             slots, arenas, table):
+        blocks = jitted(subj_of, subj_keys, subj_store, subj_before,
+                        subj_kinds, slots, arenas, table)
+        return _concat_lane_blocks(mesh, blocks)
+
+    return call
+
+
+@functools.lru_cache(maxsize=32)
+def sharded_fused_range_deps_resolve(mesh: Mesh, nr: int, nk: int):
+    """Mesh-sharded twin of ops.kernels.fused_range_deps_resolve: NR range
+    arenas (interval stab, rows over 'data') and NK key arenas
+    (bucket-contracted coverage test over 'model', like
+    sharded_range_deps_resolve) answer one fused call; per-store blocks
+    concatenate outside the shard_map and outside the jit via
+    _concat_lane_blocks (see its docstring). Empty sides return a (b, 0)
+    packed array the caller discards."""
+    from accord_tpu.ops.kernels import _lex_before, _pack_bits
+    model = mesh.shape["model"]
+
+    def run(iv_of, iv_start, iv_end, subj_store, subj_before, subj_kinds,
+            subj_is_range, r_slots, rarenas, k_slots, karenas, table):
+        def part(ivo, ivs, ive, sst, sb, sknd, srng,
+                 rsl, rars, ksl, kars, tbl):
+            b = sb.shape[0]
+            routs = []
+            for s in range(nr):
+                rs, re_, rts, rkd, rvl = rars[s]
+                rcap_l = rs.shape[0]
+                hit_r = (ivs[:, None] < re_[None, :]) \
+                    & (rs[None, :] < ive[:, None])
+                any_r = jnp.zeros((b, rcap_l), jnp.int32) \
+                    .at[ivo].max(hit_r.astype(jnp.int32), mode="drop") > 0
+                witness_r = tbl[sknd[:, None], rkd[None, :]] == 1
+                before_r = _lex_before(rts[None, :, :], sb[:, None, :])
+                mine = (sst == rsl[s])[:, None]
+                routs.append(_pack_bits(
+                    any_r & witness_r & before_r & rvl[None, :] & mine))
+            kouts = []
+            if nk:
+                cov = _covered_buckets(ivo, ivs, ive, b,
+                                       kars[0][0].shape[1], model)
+                for s in range(nk):
+                    bm, kts, kknd, kvl = kars[s]
+                    partial = jax.lax.dot_general(
+                        cov, bm.astype(jnp.bfloat16),
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                    any_k = jax.lax.psum(partial, "model") > 0.5
+                    witness_k = tbl[sknd[:, None], kknd[None, :]] == 1
+                    before_k = _lex_before(kts[None, :, :], sb[:, None, :])
+                    mine = (sst == ksl[s])[:, None] & srng[:, None]
+                    kouts.append(_pack_bits(
+                        any_k & witness_k & before_k & kvl[None, :] & mine))
+            return tuple(routs) + tuple(kouts)
+
+        rarena_specs = tuple(
+            (P("data"), P("data"), P("data", None), P("data"), P("data"))
+            for _ in range(nr))
+        karena_specs = tuple(
+            (P("data", "model"), P("data", None), P("data"), P("data"))
+            for _ in range(nk))
+        return shard_map(
+            part, mesh=mesh,
+            in_specs=(P(None), P(None), P(None), P(None), P(None, None),
+                      P(None), P(None), P(None), rarena_specs, P(None),
+                      karena_specs, P(None, None)),
+            out_specs=tuple(P(None, "data") for _ in range(nr + nk)),
+        )(iv_of, iv_start, iv_end, subj_store, subj_before, subj_kinds,
+          subj_is_range, r_slots, rarenas, k_slots, karenas, table)
+
+    jitted = jax.jit(run)
+
+    def call(iv_of, iv_start, iv_end, subj_store, subj_before, subj_kinds,
+             subj_is_range, r_slots, rarenas, k_slots, karenas, table):
+        blocks = jitted(iv_of, iv_start, iv_end, subj_store, subj_before,
+                        subj_kinds, subj_is_range, r_slots, rarenas,
+                        k_slots, karenas, table)
+        b = subj_before.shape[0]
+        rpacked = _concat_lane_blocks(mesh, blocks[:nr]) if nr \
+            else jnp.zeros((b, 0), jnp.uint32)
+        kpacked = _concat_lane_blocks(mesh, blocks[nr:]) if nk \
+            else jnp.zeros((b, 0), jnp.uint32)
+        return rpacked, kpacked
+
+    return call
 
 
 def warmup_sharded(mesh: Mesh, num_buckets: int = 256, cap: int = 4096,
                    batch_tiers: Tuple[int, ...] = (8, 64, 128),
                    nnz_tiers: Optional[Tuple[int, ...]] = None,
-                   range_cap: Optional[int] = None) -> None:
-    """Pre-compile the sharded hot kernels' (batch tier, nnz tier) jit
-    cross product (the sharded twin of ops.resolver.warmup; same padding
-    ladders the overlapped pipeline dispatches). One call covers every
-    ShardedBatchDepsResolver on the same mesh + (num_buckets, cap,
-    range_cap) -- the kernel builders are lru_cached by mesh and jit caches
-    by shape."""
+                   range_cap: Optional[int] = None,
+                   store_tiers: Tuple[int, ...] = (1, 2)) -> None:
+    """Pre-compile the sharded hot kernels' (batch tier, nnz tier, store
+    tier) jit cross product (the sharded twin of ops.resolver.warmup; same
+    padding ladders the overlapped pipeline dispatches). Store tiers >= 2
+    warm the fused cross-store kernels; single-group dispatches reuse the
+    plain kernels. One call covers every ShardedBatchDepsResolver on the
+    same mesh + (num_buckets, cap, range_cap) -- the kernel builders are
+    lru_cached by (mesh, width) and jit caches by shape."""
     from accord_tpu.ops.encoding import WITNESS_TABLE
     from accord_tpu.ops.kernels import NNZ_TIERS
     if nnz_tiers is None:
@@ -253,13 +444,9 @@ def warmup_sharded(mesh: Mesh, num_buckets: int = 256, cap: int = 4096,
         range_cap = max(64, 32 * mesh.shape["data"])
     kern = sharded_deps_resolve(mesh)
     rkern = sharded_range_deps_resolve(mesh)
-    neg = np.iinfo(np.int32).min
-    pos = np.iinfo(np.int32).max
     bm = jnp.zeros((cap, num_buckets), jnp.float32)
     ts = jnp.zeros((cap, 3), jnp.int32)
     kinds = jnp.zeros(cap, jnp.int32)
-    kmin = jnp.full(cap, pos, jnp.int32)
-    kmax = jnp.full(cap, neg, jnp.int32)
     valid = jnp.zeros(cap, bool)
     rs = jnp.zeros(range_cap, jnp.int32)
     re_ = jnp.zeros(range_cap, jnp.int32)
@@ -272,13 +459,25 @@ def warmup_sharded(mesh: Mesh, num_buckets: int = 256, cap: int = 4096,
         sb = jnp.zeros((b, 3), jnp.int32)
         sknd = jnp.zeros(b, jnp.int32)
         srng = jnp.zeros(b, bool)
+        sst = jnp.zeros(b, jnp.int32)
         for z in nnz_tiers:
             of = jnp.full(z, b, jnp.int32)
             zz = jnp.zeros(z, jnp.int32)
             out = kern(of, zz, sb, sknd, bm, ts, kinds, valid, table)
             out = rkern(of, zz, zz, sb, sknd, srng,
                         rs, re_, rts, rkd, rvl,
-                        kmin, kmax, ts, kinds, valid, table)
+                        bm, ts, kinds, valid, table)
+            for s in store_tiers:
+                if s < 2:
+                    continue  # single group runs the plain kernels
+                fkern = sharded_fused_deps_resolve(mesh, s)
+                frkern = sharded_fused_range_deps_resolve(mesh, s, s)
+                slots = jnp.arange(s, dtype=jnp.int32)
+                arenas = tuple((bm, ts, kinds, valid) for _ in range(s))
+                out = fkern(of, zz, sst, sb, sknd, slots, arenas, table)
+                rarenas = tuple((rs, re_, rts, rkd, rvl) for _ in range(s))
+                out = frkern(of, zz, zz, sst, sb, sknd, srng,
+                             slots, rarenas, slots, arenas, table)
     if out is not None:
         jax.block_until_ready(out)
 

@@ -216,10 +216,6 @@ def bench_pipeline(quick: bool):
         raise AssertionError(
             f"large replay hit {resolver.host_fallbacks - fall0} stale-arena "
             "host fallbacks (generation pinning should translate instead)")
-    if resolver.host_only:
-        raise AssertionError(
-            f"retired host_only residual ran {resolver.host_only} times "
-            "(the CSR encoding must keep every subject width on device)")
     cache1 = jit_cache_sizes()
     if cache1 != cache0:
         raise AssertionError(
@@ -261,9 +257,9 @@ def bench_pipeline(quick: bool):
             "prefetched": resolver.prefetched - pre0,
             "stale_harvests": resolver.stale_harvests - stale0,
             "host_fallbacks": resolver.host_fallbacks - fall0,
-            "host_only_residual": resolver.host_only,      # asserted 0
             "range_fallbacks": resolver.range_fallbacks,
             "upload_bytes": resolver.upload_bytes,
+            "upload_bytes_by_field": resolver.upload_bytes_by_field,
             "recompiles_in_window": 0,                      # asserted above
             "host_serial_projected_s": round(host_projected_s, 1),
             "vs_host_serial": round(host_projected_s / max(replay_wall, 1e-9), 2),
@@ -283,7 +279,9 @@ def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool):
     factory = None
     samples = []
     orig = None
+    cache0 = None
     if device:
+        from accord_tpu.ops.kernels import jit_cache_sizes
         from accord_tpu.ops.resolver import BatchDepsResolver
 
         def factory():
@@ -292,6 +290,8 @@ def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool):
                                   max_dispatch=256)
             resolvers.append(r)
             return r
+
+        cache0 = jit_cache_sizes()  # warmup covered the multi-store tiers
     else:
         import accord_tpu.local.store as store_mod
         orig = store_mod.CommandStore.host_calculate_deps
@@ -328,8 +328,36 @@ def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool):
     wall = time.perf_counter() - t0
     stats = {}
     if device:
+        from accord_tpu.ops.kernels import jit_cache_sizes
+        cache1 = jit_cache_sizes()
+        if cache1 != cache0:
+            raise AssertionError(
+                f"jit tiers compiled inside the e2e burn: {cache0} -> "
+                f"{cache1} (warmup store_tiers coverage is stale)")
+        dispatches = sum(r.dispatches for r in resolvers)
+        ticks = sum(r.ticks for r in resolvers)
+        # fused cross-store dispatch engaged: a per-store drain would pay
+        # stores_per_node dispatches per tick
+        if ticks and dispatches >= cfg.stores_per_node * ticks:
+            raise AssertionError(
+                f"fused dispatch disengaged: {dispatches} dispatches over "
+                f"{ticks} ticks with {cfg.stores_per_node} stores/node")
+        ub = sum(r.upload_bytes for r in resolvers)
+        ube = sum(r.upload_bytes_full_equiv for r in resolvers)
+        # field-granular deltas pay off on this status-bump-heavy burn:
+        # actual upload bytes must be strictly below the full-row baseline
+        if not ub < ube:
+            raise AssertionError(
+                f"granular uploads not below full-row baseline: "
+                f"{ub} >= {ube}")
+        by_field = {}
+        for r in resolvers:
+            for k, v in r.upload_bytes_by_field.items():
+                by_field[k] = by_field.get(k, 0) + v
         stats = {
-            "dispatches": sum(r.dispatches for r in resolvers),
+            "dispatches": dispatches,
+            "ticks": ticks,
+            "dispatches_per_tick": round(dispatches / max(ticks, 1), 3),
             "subjects": sum(r.subjects for r in resolvers),
             "encode_s": round(sum(r.encode_s for r in resolvers), 2),
             "harvest_stall_s": round(sum(r.harvest_stall_s for r in resolvers), 2),
@@ -337,9 +365,10 @@ def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool):
             "prefetched": sum(r.prefetched for r in resolvers),
             "stale_harvests": sum(r.stale_harvests for r in resolvers),
             "host_fallbacks": sum(r.host_fallbacks for r in resolvers),
-            "host_only_residual": sum(r.host_only for r in resolvers),
             "range_fallbacks": sum(r.range_fallbacks for r in resolvers),
-            "upload_bytes": sum(r.upload_bytes for r in resolvers),
+            "upload_bytes": ub,
+            "upload_bytes_by_field": by_field,
+            "upload_bytes_full_equiv": ube,
         }
     else:
         stats = {
@@ -423,7 +452,6 @@ def bench_range_mix(quick: bool):
         raise AssertionError(f"range-mix burn lost {rep_a.lost} acked txns")
     counters = {
         "host_fallbacks": sum(r.host_fallbacks for r in res_a),
-        "host_only_residual": sum(r.host_only for r in res_a),
         "range_fallbacks": sum(r.range_fallbacks for r in res_a),
     }
     bad = {k: v for k, v in counters.items() if v}
@@ -556,16 +584,22 @@ def main(argv=None) -> int:
 
         from accord_tpu.ops.resolver import warmup
         t0 = time.perf_counter()
+        # store_tiers=(1, 2): the e2e cluster runs 2 stores/node, so the
+        # fused cross-store tiers must be pre-compiled for its
+        # zero-recompile assertion (single-group dispatches reuse the
+        # plain kernels, warmed by store tier 1)
         warmup(num_buckets=E2E_BUCKETS, cap=E2E_ARENA_CAP,
-               batch_tiers=(8, 64, 128, 256), scatter_tiers=(8, 64))
+               batch_tiers=(8, 64, 128, 256), scatter_tiers=(8, 64),
+               store_tiers=(1, 2))
         # the large replay's admission windows dispatch anywhere between 129
         # and PIPE_BATCH subjects (~4 keys each), so every intermediate
         # subject tier and the 4096-entry CSR tier must be pre-compiled for
-        # the zero-recompile assertion to hold in the timed window
+        # the zero-recompile assertion to hold in the timed window (single
+        # store per node: no fused tiers needed)
         warmup(num_buckets=PIPE_BUCKETS, cap=PIPE_CAP,
                batch_tiers=(8, 64, 128, 256, 512, PIPE_BATCH),
                scatter_tiers=(8, 64),
-               nnz_tiers=(32, 256, 2048, 4096))
+               nnz_tiers=(32, 256, 2048, 4096), store_tiers=(1,))
         warm_s = time.perf_counter() - t0
 
         pipeline = bench_pipeline(args.quick)

@@ -46,7 +46,7 @@ def test_compaction_with_two_calls_in_flight():
                  for _ in range(40)]
     live = _preaccept_population(store, node, live_keys)
 
-    arena = resolver._arenas[id(node)]
+    arena = resolver._arenas[id(store)]
     for t, ks in zip(chaff, chaff_keys):
         resolver.on_prune(store, t, ks)
 
@@ -84,7 +84,6 @@ def test_compaction_with_two_calls_in_flight():
     # both harvests crossed the compaction on the DEVICE path
     assert resolver.stale_harvests == 2
     assert resolver.host_fallbacks == 0
-    assert resolver.host_only == 0   # retired residual must never run
     # drained: pins released, snapshot dropped, poll disarmed
     cluster.queue.drain(max_events=10_000)
     assert gen0 not in arena.retired_ids
@@ -109,7 +108,7 @@ def test_harvest_order_and_reuse_after_compaction():
     live_keys = [sorted(set(rng.integers(0, 8, 2).tolist()))
                  for _ in range(30)]
     live = _preaccept_population(store, node, live_keys)
-    arena = resolver._arenas[id(node)]
+    arena = resolver._arenas[id(store)]
     for t, ks in zip(chaff, chaff_keys):
         resolver.on_prune(store, t, ks)
 
@@ -130,7 +129,6 @@ def test_harvest_order_and_reuse_after_compaction():
     assert out1.done
     assert resolver.stale_harvests == 1  # unchanged
     assert resolver.host_fallbacks == 0
-    assert resolver.host_only == 0
     host = store.host_calculate_deps(t1, Keys(live_keys[28]), before1)
     assert out1.value() == host
 

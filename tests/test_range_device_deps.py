@@ -2,9 +2,9 @@
 must answer EXACTLY what the host scans answer, for every mix of key/range
 subjects against key/range conflict state -- including subject rows wider
 than the retired MAXK=16 scatter, truncation/prune of range txns, and the
-range arena compacting while calls are in flight. The retired-residual
-counters (host_only, host_fallbacks, range_fallbacks) must stay zero
-throughout: any nonzero means the device path silently left the kernel."""
+range arena compacting while calls are in flight. The fallback counters
+(host_fallbacks, range_fallbacks) must stay zero throughout: any nonzero
+means the device path silently left the kernel."""
 from __future__ import annotations
 
 import numpy as np
@@ -73,7 +73,6 @@ def _subjects(store, node, rng, tss, n=40):
 
 def _assert_counters_zero(resolver):
     assert resolver.host_fallbacks == 0
-    assert resolver.host_only == 0
     assert resolver.range_fallbacks == 0
 
 
@@ -84,7 +83,7 @@ def test_randomized_mixed_differential():
     store.deps_resolver = resolver   # registrations funnel via on_register
     _, tss = _register_mixed(store, node, rng)
 
-    arena = resolver._arenas[id(node)]
+    arena = resolver._arenas[id(store)]
     # the population really exercised the retired limits: a row wider than
     # the old MAXK scatter, and a grown interval arena
     assert max(len(m) for m in arena.row_mods if m is not None) > 16
@@ -111,7 +110,7 @@ def test_range_truncation_and_prune():
     store.deps_resolver = resolver
     rids, tss = _register_mixed(store, node, rng, n_key=30, n_range=30)
 
-    arena = resolver._arenas[id(node)]
+    arena = resolver._arenas[id(store)]
     for tid in rids[::2]:
         store.range_txns.pop(tid, None)
         store.range_index.remove(tid)
@@ -151,7 +150,7 @@ def test_compaction_with_range_calls_in_flight():
     node.device_poll_ms = 1.0
     rids, _ = _register_mixed(store, node, rng, n_key=30, n_range=40)
 
-    arena = resolver._arenas[id(node)]
+    arena = resolver._arenas[id(store)]
     far = Timestamp(node.epoch, node.time_service.now_micros() + 50_000,
                     0, node.id)
     subs = []
