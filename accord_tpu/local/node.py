@@ -225,6 +225,25 @@ class Node:
         else:
             self.receive(request, self.id, None)
 
+    # -- lifecycle -----------------------------------------------------------
+    def shutdown(self) -> None:
+        """Graceful stop of the device deps pipeline: flush every attached
+        resolver's staged (encode-ahead) plans AND in-flight device calls
+        for this node, so no enqueued AsyncResult strands once the scheduler
+        stops delivering this node's events. Idempotent; a node with no
+        batched resolver is a no-op."""
+        if self.command_stores is None:
+            return
+        drained = set()
+        for store in self.command_stores.all():
+            resolver = store.deps_resolver
+            if resolver is None or id(resolver) in drained:
+                continue
+            drained.add(id(resolver))
+            drain = getattr(resolver, "drain", None)
+            if drain is not None:
+                drain(self)
+
 
 class _ReliableSend:
     """Fire-and-forget with retries: epoch gossip must survive chaos, so

@@ -59,7 +59,14 @@ class ExecPlane:
         self.applied = np.zeros(self.cap, dtype=bool)
         self.pending = np.zeros(self.cap, dtype=bool)
         self.awaits_all = np.zeros(self.cap, dtype=bool)
-        self._dirty: set = set()
+        # per-field dirty sets (same scheme as the resolver arenas): `full`
+        # rows re-ship every lane (new rows, stable ingests, edge rewrites);
+        # ts/flags rows ship just that lane group via the shared flush_lane
+        # helper -- an executeAt bump no longer re-uploads a cap/8-byte
+        # adjacency row
+        self._dirty_full: set = set()
+        self._dirty_ts: set = set()
+        self._dirty_flags: set = set()
         self._device = None
         self._ticking = False
         self._gen = 0   # bumped by compaction: retires in-flight frontiers
@@ -77,6 +84,13 @@ class ExecPlane:
         self.harvest_stall_s = 0.0
         self.prefetched = 0
         self.upload_bytes = 0
+        # field-granular accounting, mirroring the resolver arenas:
+        # upload_bytes == sum of the by-field buckets; full_equiv is what
+        # the retired whole-row scheme would have shipped for the same
+        # dirty sets (the baseline proving the granular deltas' win)
+        self.upload_bytes_by_field: Dict[str, int] = \
+            {"full": 0, "ts": 0, "flags": 0}
+        self.upload_bytes_full_equiv = 0
 
     # -- row management ------------------------------------------------------
     def _row(self, txn_id: TxnId) -> int:
@@ -91,7 +105,7 @@ class ExecPlane:
         self.count += 1
         self.row_of[txn_id] = row
         self.txn_ids.append(txn_id)
-        self._dirty.add(row)
+        self._dirty_full.add(row)
         return row
 
     def _ensure_capacity(self, n: int) -> None:
@@ -194,7 +208,9 @@ class ExecPlane:
         self.awaits_all[:] = False
         self._released = set()
         self._device = None
-        self._dirty = set()
+        self._dirty_full = set()
+        self._dirty_ts = set()
+        self._dirty_flags = set()
         self._gen += 1
         for tid in live:
             row = self._row(tid)
@@ -250,7 +266,7 @@ class ExecPlane:
             self.adj[row, d >> 5] |= np.uint32(1 << (d & 31))
         self.pending[row] = True
         self._released.discard(row)
-        self._dirty.add(row)
+        self._dirty_full.add(row)
         self._schedule_tick()
 
     def on_status(self, cmd) -> None:
@@ -268,14 +284,15 @@ class ExecPlane:
             enc = self._encode(cmd.execute_at)
             if not np.array_equal(self.exec_ts[row], enc):
                 self.exec_ts[row] = enc
+                self._dirty_ts.add(row)
                 changed = True
         if cmd.has_been(Status.APPLIED) or cmd.status.is_terminal:
             if not self.applied[row] or self.pending[row]:
                 self.applied[row] = True
                 self.pending[row] = False
+                self._dirty_flags.add(row)
                 changed = True
         if changed:
-            self._dirty.add(row)
             self._schedule_tick()
 
     def on_edges_changed(self, cmd) -> None:
@@ -300,7 +317,7 @@ class ExecPlane:
         if np.array_equal(new_adj, self.adj[row]):
             return  # elision rewrote to the same edges: nothing to upload
         self.adj[row] = new_adj
-        self._dirty.add(row)
+        self._dirty_full.add(row)
         self._schedule_tick()
 
     def on_erased(self, txn_id: TxnId) -> None:
@@ -309,7 +326,7 @@ class ExecPlane:
             return
         self.applied[row] = True   # an erased record gates nothing
         self.pending[row] = False
-        self._dirty.add(row)
+        self._dirty_flags.add(row)
         self._schedule_tick()
 
     # -- the tick/harvest pipeline -------------------------------------------
@@ -323,7 +340,8 @@ class ExecPlane:
         self._ticking = False
         if not self.pending.any():
             return
-        if not self._dirty and self._device is not None:
+        if not (self._dirty_full or self._dirty_ts or self._dirty_flags) \
+                and self._device is not None:
             # unchanged arena => identical frontier, already harvested; the
             # next on_* hook re-arms the tick
             return
@@ -361,8 +379,14 @@ class ExecPlane:
 
         poll(interval, prefetch)
 
+    def _full_row_bytes(self, m: int) -> int:
+        """Bytes one whole-row exec_scatter chunk of tier m ships: row index
+        + packed adjacency + exec_ts + applied/pending/awaits flags."""
+        return m * (4 + self.cap // 8 + 12 + 3)
+
     def _dispatch(self):
         import jax.numpy as jnp
+        from accord_tpu.ops.deltas import flush_lane, lane_row_tier
         from accord_tpu.ops.kernels import exec_scatter, execution_frontier
         if self._device is None:
             # the device adjacency lives UNPACKED (bool[cap, cap]); build it
@@ -373,19 +397,60 @@ class ExecPlane:
                 jnp.full((self.cap, 3), _NEG, jnp.int32),
                 jnp.zeros(self.cap, bool), jnp.zeros(self.cap, bool),
                 jnp.zeros(self.cap, bool))
-            self._dirty = set(range(self.count))
-        if self._dirty:
-            # fancy-indexed selections below COPY, so the async computation
-            # never aliases the live host shadows (zero-copy aliasing on the
-            # CPU backend raced host mutations and broke determinism)
-            rows = np.asarray(sorted(self._dirty), dtype=np.int32)
-            uploads = (rows, self.adj[rows], self.exec_ts[rows],
-                       self.applied[rows], self.pending[rows],
-                       self.awaits_all[rows])
-            self.upload_bytes += sum(u.nbytes for u in uploads)
-            self._device = exec_scatter(
-                *self._device, *(jnp.asarray(u) for u in uploads))
-            self._dirty.clear()
+            self._dirty_full = set(range(self.count))
+            self._dirty_ts.clear()
+            self._dirty_flags.clear()
+        if self._dirty_full:
+            # the full upload carries every lane: granular marks on the
+            # same rows are satisfied by it
+            self._dirty_ts -= self._dirty_full
+            self._dirty_flags -= self._dirty_full
+            full = sorted(self._dirty_full)
+            for lo in range(0, len(full), 64):
+                chunk = full[lo:lo + 64]
+                # pad to the shared 8/64 row tiers by repeating the first
+                # row (duplicate scatter indexes write identical data), so
+                # dirty-count drift never mints a new compiled shape
+                m = lane_row_tier(len(chunk))
+                rows = np.full(m, chunk[0], dtype=np.int32)
+                rows[:len(chunk)] = chunk
+                # fancy-indexed selections below COPY, so the async
+                # computation never aliases the live host shadows (zero-copy
+                # aliasing on the CPU backend raced host mutations and broke
+                # determinism)
+                uploads = (rows, self.adj[rows], self.exec_ts[rows],
+                           self.applied[rows], self.pending[rows],
+                           self.awaits_all[rows])
+                nb = sum(u.nbytes for u in uploads)
+                self.upload_bytes += nb
+                self.upload_bytes_by_field["full"] += nb
+                self.upload_bytes_full_equiv += nb
+                self._device = exec_scatter(
+                    *self._device, *(jnp.asarray(u) for u in uploads))
+            self._dirty_full.clear()
+        if self._dirty_ts or self._dirty_flags:
+            # all-lanes baseline FIRST, over the union of granular rows
+            # chunked exactly like the whole-row scheme would have
+            union = sorted(self._dirty_ts | self._dirty_flags)
+            for lo in range(0, len(union), 64):
+                self.upload_bytes_full_equiv += self._full_row_bytes(
+                    lane_row_tier(len(union[lo:lo + 64])))
+            d = list(self._device)
+
+            def acct(field):
+                def on_chunk(nbytes: int, _m: int) -> None:
+                    self.upload_bytes += nbytes
+                    self.upload_bytes_by_field[field] += nbytes
+                return on_chunk
+
+            d[1] = flush_lane(d[1], sorted(self._dirty_ts), self.exec_ts,
+                              acct("ts"))
+            self._dirty_ts.clear()
+            flags = sorted(self._dirty_flags)
+            d[2] = flush_lane(d[2], flags, self.applied, acct("flags"))
+            d[3] = flush_lane(d[3], flags, self.pending, acct("flags"))
+            self._dirty_flags.clear()
+            self._device = tuple(d)
         out = execution_frontier(*self._device)
         out.copy_to_host_async()
         self.dispatches += 1

@@ -299,6 +299,28 @@ def fused_deps_resolve(subj_of, subj_keys, subj_store, subj_before,
     return jnp.concatenate(outs, axis=1)
 
 
+def covered_buckets(iv_of, iv_start, iv_end, b, k_local, base, k_total):
+    """Per-subject covered-bucket mask from a CSR interval list under the
+    modular bucket hash `key % k_total`: bucket `base + j` is covered by the
+    half-open interval [s, e) iff some integer in [s, e) lands in it, i.e.
+    `(base + j - s) mod k_total < e - s`. Intervals spanning >= k_total keys
+    (and degenerate/padding widths <= 0) cover every bucket. Exact under
+    int32 wraparound ONLY when k_total divides 2^32 -- callers assert
+    power-of-two bucket counts. `base` may be traced (shard_map axis offset);
+    single-device callers pass 0 with k_local == k_total.
+
+    -> bf16[b, k_local] covered-bucket matrix (padding iv_of == b dropped)
+    """
+    j = base + jnp.arange(k_local, dtype=jnp.int32)
+    width = iv_end - iv_start
+    wide = (width <= 0) | (width >= k_total)
+    covered = wide[:, None] | (
+        jnp.mod(j[None, :] - iv_start[:, None], k_total) < width[:, None])
+    return jnp.zeros((b, k_local), jnp.float32) \
+        .at[iv_of].max(covered.astype(jnp.float32), mode="drop") \
+        .astype(jnp.bfloat16)
+
+
 @jax.jit
 def fused_range_deps_resolve(iv_of, iv_start, iv_end, subj_store,
                              subj_before, subj_kinds, subj_is_range,
@@ -306,11 +328,11 @@ def fused_range_deps_resolve(iv_of, iv_start, iv_end, subj_store,
                              witness_table):
     """Cross-store fused twin of range_deps_resolve. `rarenas` holds the
     participating stores' RANGE-arena lanes (starts, ends, ts, kinds, valid),
-    `karenas` the stores' key-arena hull lanes (kmin, kmax, ts, kinds,
-    valid); either tuple may be empty (that side returns a zero-width
-    buffer). Store routing works like fused_deps_resolve: each block masks
-    by its slot in the subj_store lane, and blocks concatenate along the
-    packed word axis in tuple order.
+    `karenas` the stores' key-arena lanes (bitmaps, ts, kinds, valid) tested
+    by covered-bucket contraction (see range_deps_resolve); either tuple may
+    be empty (that side returns a zero-width buffer). Store routing works
+    like fused_deps_resolve: each block masks by its slot in the subj_store
+    lane, and blocks concatenate along the packed word axis in tuple order.
 
     -> (u32[B, sum(rcap_s)/32], u32[B, sum(cap_s)/32])
     """
@@ -328,12 +350,13 @@ def fused_range_deps_resolve(iv_of, iv_start, iv_end, subj_store,
         routs.append(_pack_bits(
             any_r & witness_r & before_r & r_valid[None, :] & mine))
     kouts = []
-    for s, (k_kmin, k_kmax, k_ts, k_kinds, k_valid) in enumerate(karenas):
-        cap = k_kmin.shape[0]
-        hit_k = (iv_start[:, None] <= k_kmax[None, :]) \
-            & (k_kmin[None, :] < iv_end[:, None])
-        any_k = jnp.zeros((b, cap), jnp.int32) \
-            .at[iv_of].max(hit_k.astype(jnp.int32), mode="drop") > 0
+    if karenas:
+        k = karenas[0][0].shape[1]
+        cov = covered_buckets(iv_of, iv_start, iv_end, b, k, 0, k)
+    for s, (k_bm, k_ts, k_kinds, k_valid) in enumerate(karenas):
+        any_k = jax.lax.dot_general(
+            cov, k_bm.astype(jnp.bfloat16),
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) > 0.5
         witness_k = witness_table[subj_kinds[:, None], k_kinds[None, :]] == 1
         before_k = _lex_before(k_ts[None, :, :], subj_before[:, None, :])
         mine = (subj_store == k_slots[s])[:, None] & subj_is_range[:, None]
@@ -350,7 +373,7 @@ def fused_range_deps_resolve(iv_of, iv_start, iv_end, subj_store,
 def range_deps_resolve(iv_of, iv_start, iv_end, subj_before, subj_kinds,
                        subj_is_range,
                        r_start, r_end, r_ts, r_kinds, r_valid,
-                       k_kmin, k_kmax, k_ts, k_kinds, k_valid,
+                       k_bm, k_ts, k_kinds, k_valid,
                        witness_table):
     """The fused RANGE-overlap kernel: every subject carries a CSR list of
     half-open int32 intervals (a key subject's keys become point intervals
@@ -359,10 +382,14 @@ def range_deps_resolve(iv_of, iv_start, iv_end, subj_before, subj_kinds,
       - the RANGE arena by branch-free interval overlap
         (iv_start < r_end & r_start < iv_end), which for a point interval
         degenerates to the stabbing test r_start <= key < r_end; and
-      - the KEY arena by a conservative span compare against each row's
-        [kmin, kmax] key hull (iv_start <= kmax & kmin < iv_end) -- range
-        subjects only (key subjects get exact key deps from deps_resolve);
-        the host decode filters span false positives per real key.
+      - the KEY arena by covered-bucket contraction: the subject's intervals
+        expand to a covered-bucket mask (covered_buckets) contracted against
+        the per-row key bitmaps on the MXU -- range subjects only (key
+        subjects get exact key deps from deps_resolve); the host decode
+        filters bucket-collision false positives per real key. This replaces
+        the old per-row [kmin, kmax] hull span compare: a sparse row with a
+        wide key spread no longer candidates every interval inside its hull,
+        only intervals actually sharing a bucket.
 
     Sorted-endpoint broadcast compares beat an interval tree here: the tree's
     pointer-chasing descent is serial and branchy, while [nv, rcap] compares
@@ -375,13 +402,14 @@ def range_deps_resolve(iv_of, iv_start, iv_end, subj_before, subj_kinds,
     subj_is_range: bool[B]   True for range-domain subjects (gates the
                              key-arena output)
     r_*:           the range arena (resolver._RangeArena); rcap % 32 == 0
-    k_*:           the key arena span lanes; cap % 32 == 0
+    k_*:           the key arena lanes (k_bm f32[cap, K]); cap % 32 == 0,
+                   K a power of two (covered_buckets wraparound)
     -> (u32[B, rcap/32], u32[B, cap/32]) packed candidate bitmasks, masked by
        witness/before/valid exactly like deps_resolve
     """
     b = subj_before.shape[0]
     rcap = r_start.shape[0]
-    cap = k_kmin.shape[0]
+    k = k_bm.shape[1]
     hit_r = (iv_start[:, None] < r_end[None, :]) \
         & (r_start[None, :] < iv_end[:, None])
     any_r = jnp.zeros((b, rcap), jnp.int32) \
@@ -389,10 +417,10 @@ def range_deps_resolve(iv_of, iv_start, iv_end, subj_before, subj_kinds,
     witness_r = witness_table[subj_kinds[:, None], r_kinds[None, :]] == 1
     before_r = _lex_before(r_ts[None, :, :], subj_before[:, None, :])
     m_r = any_r & witness_r & before_r & r_valid[None, :]
-    hit_k = (iv_start[:, None] <= k_kmax[None, :]) \
-        & (k_kmin[None, :] < iv_end[:, None])
-    any_k = jnp.zeros((b, cap), jnp.int32) \
-        .at[iv_of].max(hit_k.astype(jnp.int32), mode="drop") > 0
+    cov = covered_buckets(iv_of, iv_start, iv_end, b, k, 0, k)
+    any_k = jax.lax.dot_general(
+        cov, k_bm.astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) > 0.5
     witness_k = witness_table[subj_kinds[:, None], k_kinds[None, :]] == 1
     before_k = _lex_before(k_ts[None, :, :], subj_before[:, None, :])
     m_k = any_k & witness_k & before_k & k_valid[None, :] \
@@ -401,9 +429,9 @@ def range_deps_resolve(iv_of, iv_start, iv_end, subj_before, subj_kinds,
 
 
 @jax.jit
-def arena_scatter(bitmaps, ts, exec_ts, kinds, kmin, kmax, valid,
+def arena_scatter(bitmaps, ts, exec_ts, kinds, valid,
                   rows, key_rows, key_mods, ts_rows, exec_rows, kind_rows,
-                  kmin_rows, kmax_rows, valid_rows):
+                  valid_rows):
     """Scatter dirty rows into the device arena. Bitmap rows are rebuilt on
     device from a CSR key list (key_rows i32[nnz] holds ABSOLUTE arena row
     indices; padding entries use cap -- out of bounds, dropped): each dirty
@@ -415,23 +443,19 @@ def arena_scatter(bitmaps, ts, exec_ts, kinds, kmin, kmax, valid,
             ts.at[rows].set(ts_rows),
             exec_ts.at[rows].set(exec_rows),
             kinds.at[rows].set(kind_rows),
-            kmin.at[rows].set(kmin_rows),
-            kmax.at[rows].set(kmax_rows),
             valid.at[rows].set(valid_rows))
 
 
 @jax.jit
-def arena_scatter_keys(bitmaps, kmin, kmax, rows, key_rows, key_mods,
-                       kmin_rows, kmax_rows):
+def arena_scatter_keys(bitmaps, rows, key_rows, key_mods):
     """Field-granular scatter for KEY-SET-ONLY row changes (key widening,
-    prune/truncate shrinks): rebuild the dirty rows' bitmaps from the CSR and
-    refresh their [kmin, kmax] hulls without shipping the ts/exec/kind/valid
-    lanes the change didn't touch. Same clear-then-max CSR contract as
-    arena_scatter."""
+    prune/truncate shrinks): rebuild the dirty rows' bitmaps from the CSR
+    without shipping the ts/exec/kind/valid lanes the change didn't touch.
+    Same clear-then-max CSR contract as arena_scatter. (The [kmin, kmax]
+    hull lanes this used to refresh are retired -- the range kernel now
+    contracts over the same bitmaps.)"""
     cleared = bitmaps.at[rows].set(0.0)
-    return (cleared.at[key_rows, key_mods].max(1.0, mode="drop"),
-            kmin.at[rows].set(kmin_rows),
-            kmax.at[rows].set(kmax_rows))
+    return cleared.at[key_rows, key_mods].max(1.0, mode="drop")
 
 
 @jax.jit
@@ -447,12 +471,10 @@ def range_scatter(starts, ends, ts, kinds, valid,
 
 
 @functools.partial(jax.jit, static_argnames=("new_cap",))
-def arena_grow(bitmaps, ts, exec_ts, kinds, kmin, kmax, valid, new_cap: int):
+def arena_grow(bitmaps, ts, exec_ts, kinds, valid, new_cap: int):
     """Double the arena capacity ON DEVICE (zero/neg padding) -- re-uploading
-    a full [cap, K] bitmap over the host link would cost seconds. Empty-row
-    key hulls pad to [INT32_MAX, INT32_MIN] so no interval can overlap them."""
+    a full [cap, K] bitmap over the host link would cost seconds."""
     neg = jnp.int32(np.iinfo(np.int32).min)
-    pos = jnp.int32(np.iinfo(np.int32).max)
     grow = new_cap - bitmaps.shape[0]
 
     def pad(a, value=0):
@@ -460,7 +482,7 @@ def arena_grow(bitmaps, ts, exec_ts, kinds, kmin, kmax, valid, new_cap: int):
         return jnp.pad(a, widths, constant_values=value)
 
     return (pad(bitmaps), pad(ts), pad(exec_ts, neg), pad(kinds),
-            pad(kmin, pos), pad(kmax, neg), pad(valid, False))
+            pad(valid, False))
 
 
 def pad_to(x: np.ndarray, size: int, axis: int = 0) -> np.ndarray:

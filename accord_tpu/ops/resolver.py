@@ -27,9 +27,9 @@ Range txns live in a SECOND device mirror (_RangeArena): active ranges as
 sorted-endpoint int32 pairs, one row per (txn, interval). Every dispatch that
 touches range state also runs the fused range kernel -- key subjects stab the
 interval rows with point intervals, range subjects overlap both the interval
-rows and the key arena's per-row [kmin, kmax] key hulls -- so range-domain
-subjects ride the same dispatch/harvest pipeline and the old per-harvest host
-scans are retired. Decode stays exact: candidate rows translate to txn ids
+rows and the key arena's bucket bitmaps (covered-bucket contraction on the
+MXU) -- so range-domain subjects ride the same dispatch/harvest pipeline and
+the old per-harvest host scans are retired. Decode stays exact: candidate rows translate to txn ids
 and are re-filtered host-side per real key/range before entering the Deps.
 
 Async protocol (deterministic, overlapped): a node tick drains every store's
@@ -119,7 +119,8 @@ class HostDepsResolver(DepsResolver):
 def warmup(num_buckets: int = 1024, cap: int = 8192,
            batch_tiers=(8, 64, 128), scatter_tiers=(8, 64),
            nnz_tiers=None, scatter_nnz_tiers=None,
-           range_cap: int = 64, store_tiers=(1, 2)) -> None:
+           range_cap: int = 64, store_tiers=(1, 2),
+           exec_caps=()) -> None:
     """Pre-compile the jit shape tiers the async pipeline uses (first
     compilation costs seconds on a tunnelled TPU; production would do the
     same at process start). The jit cache is process-global, so one call
@@ -128,12 +129,16 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
     The CSR encoding makes each kernel's shape a (batch tier, nnz tier)
     PAIR, and the fused cross-store kernels add a third axis: the
     participating-store count (`store_tiers` -- jit specializes on the
-    arena-tuple structure). Warmup compiles the cross product -- a handful
-    of variants, bounded by the deliberately short tier ladders in
-    ops/kernels.py. The bench asserts zero recompiles inside its timed
-    windows against exactly this coverage (kernels.jit_cache_sizes),
-    including the field-granular delta scatters (arena_scatter_keys and the
-    single-lane scatter_rows used by ts-only / valid-only updates)."""
+    arena-tuple structure; the staged pipeline dispatches the same tiers one
+    tick later, so encode-ahead adds no new shapes). Warmup compiles the
+    cross product -- a handful of variants, bounded by the deliberately
+    short tier ladders in ops/kernels.py. The bench asserts zero recompiles
+    inside its timed windows against exactly this coverage
+    (kernels.jit_cache_sizes), including the field-granular delta scatters
+    (arena_scatter_keys and the single-lane scatter_rows used by ts-only /
+    valid-only updates). `exec_caps` additionally warms the exec_plane's
+    per-field lane deltas (exec-ts / applied / pending rows) for each
+    execution-arena capacity in use."""
     import jax.numpy as jnp
     from accord_tpu.ops.kernels import (NNZ_TIERS, SCATTER_NNZ_TIERS,
                                         arena_scatter, arena_scatter_keys,
@@ -146,13 +151,10 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
     if scatter_nnz_tiers is None:
         scatter_nnz_tiers = SCATTER_NNZ_TIERS
     neg = np.iinfo(np.int32).min
-    pos = np.iinfo(np.int32).max
     bm = jnp.zeros((cap, num_buckets), jnp.float32)
     ts = jnp.zeros((cap, 3), jnp.int32)
     ex = jnp.full((cap, 3), neg, jnp.int32)
     kd = jnp.zeros(cap, jnp.int32)
-    kmin = jnp.full(cap, pos, jnp.int32)
-    kmax = jnp.full(cap, neg, jnp.int32)
     vl = jnp.zeros(cap, bool)
     rs = jnp.zeros(range_cap, jnp.int32)
     re_ = jnp.zeros(range_cap, jnp.int32)
@@ -164,15 +166,13 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
     for m in scatter_tiers:
         for z in scatter_nnz_tiers:
             out = arena_scatter(
-                bm, ts, ex, kd, kmin, kmax, vl, jnp.zeros(m, jnp.int32),
+                bm, ts, ex, kd, vl, jnp.zeros(m, jnp.int32),
                 jnp.full(z, cap, jnp.int32), jnp.zeros(z, jnp.int32),
                 jnp.zeros((m, 3), jnp.int32), jnp.zeros((m, 3), jnp.int32),
-                jnp.zeros(m, jnp.int32), jnp.full(m, pos, jnp.int32),
-                jnp.full(m, neg, jnp.int32), jnp.zeros(m, bool))
+                jnp.zeros(m, jnp.int32), jnp.zeros(m, bool))
             out = arena_scatter_keys(
-                bm, kmin, kmax, jnp.zeros(m, jnp.int32),
-                jnp.full(z, cap, jnp.int32), jnp.zeros(z, jnp.int32),
-                jnp.full(m, pos, jnp.int32), jnp.full(m, neg, jnp.int32))
+                bm, jnp.zeros(m, jnp.int32),
+                jnp.full(z, cap, jnp.int32), jnp.zeros(z, jnp.int32))
         out = range_scatter(
             rs, re_, rts, rkd, rvl, jnp.zeros(m, jnp.int32),
             jnp.zeros(m, jnp.int32), jnp.zeros(m, jnp.int32),
@@ -184,6 +184,15 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
                            jnp.zeros((m, 3), jnp.int32))
         out = scatter_rows(vl, jnp.zeros(m, jnp.int32), jnp.zeros(m, bool))
         out = scatter_rows(rvl, jnp.zeros(m, jnp.int32), jnp.zeros(m, bool))
+        # the exec_plane's per-field lane deltas share scatter_rows; its
+        # arena capacity differs from the resolver's, so warm each in use
+        for ecap in exec_caps:
+            ets = jnp.full((ecap, 3), neg, jnp.int32)
+            eflag = jnp.zeros(ecap, bool)
+            out = scatter_rows(ets, jnp.zeros(m, jnp.int32),
+                               jnp.zeros((m, 3), jnp.int32))
+            out = scatter_rows(eflag, jnp.zeros(m, jnp.int32),
+                               jnp.zeros(m, bool))
     for b in batch_tiers:
         sb = jnp.zeros((b, 3), jnp.int32)
         sknd = jnp.zeros(b, jnp.int32)
@@ -195,7 +204,7 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
             out = deps_resolve(of, zz, sb, sknd, bm, ts, kd, vl, table)
             out = range_deps_resolve(of, zz, zz, sb, sknd, srng,
                                      rs, re_, rts, rkd, rvl,
-                                     kmin, kmax, ts, kd, vl, table)
+                                     bm, ts, kd, vl, table)
             for s in store_tiers:
                 if s < 2:
                     continue  # single-group dispatches use the plain kernels
@@ -204,7 +213,7 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
                 out = fused_deps_resolve(of, zz, sst, sb, sknd, slots,
                                          arenas, table)
                 rarenas = tuple((rs, re_, rts, rkd, rvl) for _ in range(s))
-                karenas = tuple((kmin, kmax, ts, kd, vl) for _ in range(s))
+                karenas = tuple((bm, ts, kd, vl) for _ in range(s))
                 out = fused_range_deps_resolve(of, zz, zz, sst, sb, sknd,
                                                srng, slots, rarenas, slots,
                                                karenas, table)
@@ -235,9 +244,10 @@ class _StoreArena:
     positives).
 
     Device arrays (authoritative once scattered): bitmaps f32[cap, K],
-    ts i32[cap, 3], exec_ts i32[cap, 3], kinds i32[cap], kmin/kmax i32[cap]
-    (the row's [min key, max key] hull, for range-subject overlap), valid
-    bool[cap]. Host shadows exist only to source dirty-row scatters and
+    ts i32[cap, 3], exec_ts i32[cap, 3], kinds i32[cap], valid bool[cap]
+    (range subjects test the same bitmaps by covered-bucket contraction --
+    the old [kmin, kmax] hull lanes are retired). Host shadows exist only
+    to source dirty-row scatters and
     exact key sets. Key lists upload as a variable-width CSR, so arbitrarily
     wide rows stay on the device path (no MAXK demotion, no host residual).
     Uploads are FIELD-GRANULAR: a row whose only change is an exec-ts bump
@@ -269,11 +279,6 @@ class _StoreArena:
         self.valid = np.zeros(self.cap, dtype=bool)
         # variable-width CSR source: sorted unique key-bucket indices per row
         self.row_mods: List[np.ndarray] = []
-        # per-row [min, max] key hull (int-clamped): the range kernel's
-        # conservative span test against range subjects. Empty rows pad to
-        # [INT32_MAX, INT32_MIN] so no interval can overlap them
-        self.kmin = np.full(self.cap, np.iinfo(np.int32).max, dtype=np.int32)
-        self.kmax = np.full(self.cap, np.iinfo(np.int32).min, dtype=np.int32)
         # per-KEY packed row bitmask (u32[cap/32]): which arena rows touch
         # the key. AND-ing it with a subject's packed dependency row yields
         # that key's dependency rows with pure numpy -- the vectorized CSR
@@ -347,10 +352,6 @@ class _StoreArena:
                               constant_values=np.iinfo(np.int32).min)
         self.kinds = np.pad(self.kinds, (0, new_cap - self.cap))
         self.valid = np.pad(self.valid, (0, new_cap - self.cap))
-        self.kmin = np.pad(self.kmin, (0, new_cap - self.cap),
-                           constant_values=np.iinfo(np.int32).max)
-        self.kmax = np.pad(self.kmax, (0, new_cap - self.cap),
-                           constant_values=np.iinfo(np.int32).min)
         for k in self.key_rows:
             self.key_rows[k] = np.pad(self.key_rows[k],
                                       (0, (new_cap - self.cap) // 32))
@@ -390,8 +391,6 @@ class _StoreArena:
         self.exec_ts[:] = np.iinfo(np.int32).min
         self.kinds[:] = 0
         self.valid[:] = False
-        self.kmin[:] = np.iinfo(np.int32).max
-        self.kmax[:] = np.iinfo(np.int32).min
         for old_row in live:
             row = self.count
             self.count += 1
@@ -524,17 +523,9 @@ class _StoreArena:
         ks = self.key_sets[row]
         if not ks:
             self.row_mods[row] = _EMPTY_I32
-            self.kmin[row] = np.iinfo(np.int32).max
-            self.kmax[row] = np.iinfo(np.int32).min
             return
-        ints = [int(k) for k in ks]
-        mods = sorted({v % self.num_buckets for v in ints})
+        mods = sorted({int(k) % self.num_buckets for k in ks})
         self.row_mods[row] = np.asarray(mods, dtype=np.int32)
-        # hull clamped to int32: an out-of-range key can never be stabbed by
-        # an ENCODABLE subject interval (endpoints are strictly inside the
-        # int32 range), so clamping loses nothing the device could see
-        self.kmin[row] = max(min(ints), np.iinfo(np.int32).min)
-        self.kmax[row] = min(max(ints), np.iinfo(np.int32).max)
 
     def _set_key_row_bit(self, key, row: int) -> None:
         kr = self.key_rows.get(key)
@@ -658,14 +649,11 @@ class _StoreArena:
         from accord_tpu.ops.kernels import scatter_nnz_tier
         if self._device is None:
             neg = np.iinfo(np.int32).min
-            pos = np.iinfo(np.int32).max
             self._device = (
                 jnp.zeros((self.cap, self.num_buckets), jnp.float32),
                 jnp.zeros((self.cap, 3), jnp.int32),
                 jnp.full((self.cap, 3), neg, jnp.int32),
                 jnp.zeros(self.cap, jnp.int32),
-                jnp.full(self.cap, pos, jnp.int32),
-                jnp.full(self.cap, neg, jnp.int32),
                 jnp.zeros(self.cap, bool),
             )
             self._dirty_full = set(range(self.count))
@@ -691,15 +679,15 @@ class _StoreArena:
                 m = 8 if len(chunk) <= 8 else 64
                 z = scatter_nnz_tier(
                     sum(len(self.row_mods[r]) for r in chunk))
-                # idx + ts + exec_ts + kinds + kmin + kmax + valid lanes
-                # (m * 41 bytes) plus the padded CSR pair (z * 8 bytes)
-                self.upload_bytes_full_equiv += m * 41 + z * 8
+                # idx + ts + exec_ts + kinds + valid lanes (m * 33 bytes)
+                # plus the padded CSR pair (z * 8 bytes)
+                self.upload_bytes_full_equiv += m * 33 + z * 8
             for chunk in self._csr_chunks(sorted(self._dirty_keys)):
                 self._scatter_keys_chunk(chunk)
             self._dirty_keys.clear()
             self._scatter_lane(sorted(self._dirty_ts), 2, "ts", self.exec_ts)
             self._dirty_ts.clear()
-            self._scatter_lane(sorted(self._dirty_valid), 6, "valid",
+            self._scatter_lane(sorted(self._dirty_valid), 4, "valid",
                                self.valid)
             self._dirty_valid.clear()
         return self._device
@@ -743,8 +731,7 @@ class _StoreArena:
             key_rows[:total] = np.repeat(np.asarray(chunk, np.int32), counts)
             key_mods[:total] = np.concatenate(mods_list)
         uploads = (idx, key_rows, key_mods, self.ts[idx], self.exec_ts[idx],
-                   self.kinds[idx], self.kmin[idx], self.kmax[idx],
-                   self.valid[idx])
+                   self.kinds[idx], self.valid[idx])
         nb = sum(a.nbytes for a in uploads)
         self.upload_bytes += nb
         self.upload_bytes_by_field["full"] += nb
@@ -753,8 +740,8 @@ class _StoreArena:
             *self._device, *(jnp.asarray(a) for a in uploads))
 
     def _scatter_keys_chunk(self, chunk: List[int]) -> None:
-        """Key-set-only delta: rebuild the rows' bitmaps from the CSR and
-        refresh their [kmin, kmax] hulls; ts/exec/kind/valid lanes stay."""
+        """Key-set-only delta: rebuild the rows' bitmaps from the CSR;
+        ts/exec/kind/valid lanes stay."""
         import jax.numpy as jnp
         from accord_tpu.ops.kernels import (arena_scatter_keys,
                                             scatter_nnz_tier)
@@ -771,35 +758,30 @@ class _StoreArena:
         if total:
             key_rows[:total] = np.repeat(np.asarray(chunk, np.int32), counts)
             key_mods[:total] = np.concatenate(mods_list)
-        uploads = (idx, key_rows, key_mods, self.kmin[idx], self.kmax[idx])
+        uploads = (idx, key_rows, key_mods)
         nb = sum(a.nbytes for a in uploads)
         self.upload_bytes += nb
         self.upload_bytes_by_field["keys"] += nb
         d = list(self._device)
-        d[0], d[4], d[5] = arena_scatter_keys(
-            d[0], d[4], d[5], *(jnp.asarray(a) for a in uploads))
+        d[0] = arena_scatter_keys(d[0], *(jnp.asarray(a) for a in uploads))
         self._device = tuple(d)
 
     def _scatter_lane(self, rows: List[int], lane: int, field: str,
                       src: np.ndarray) -> None:
         """Single-lane delta (exec-ts bumps, valid flips): ship one lane's
-        dirty rows via the generic scatter_rows kernel."""
+        dirty rows via the shared flush_lane helper (ops/deltas.py), which
+        the exec plane's field deltas ride too."""
         if not rows:
             return
-        import jax.numpy as jnp
-        from accord_tpu.ops.kernels import scatter_rows
-        for lo in range(0, len(rows), 64):
-            chunk = rows[lo:lo + 64]
-            m = 8 if len(chunk) <= 8 else 64
-            idx = np.full(m, chunk[0], dtype=np.int32)
-            idx[:len(chunk)] = chunk
-            data = src[idx]
-            self.upload_bytes += idx.nbytes + data.nbytes
-            self.upload_bytes_by_field[field] += idx.nbytes + data.nbytes
-            d = list(self._device)
-            d[lane] = scatter_rows(d[lane], jnp.asarray(idx),
-                                   jnp.asarray(data))
-            self._device = tuple(d)
+        from accord_tpu.ops.deltas import flush_lane
+
+        def account(nbytes: int, _m: int) -> None:
+            self.upload_bytes += nbytes
+            self.upload_bytes_by_field[field] += nbytes
+
+        d = list(self._device)
+        d[lane] = flush_lane(d[lane], rows, src, account)
+        self._device = tuple(d)
 
 
 class _RangeArena:
@@ -1045,7 +1027,7 @@ class _RangeArena:
     # -- device sync ----------------------------------------------------------
     def device_arrays(self):
         import jax.numpy as jnp
-        from accord_tpu.ops.kernels import range_scatter, scatter_rows
+        from accord_tpu.ops.kernels import range_scatter
         if self._device is None:
             self._device = (
                 jnp.zeros(self.cap, jnp.int32),
@@ -1074,22 +1056,18 @@ class _RangeArena:
             self._dirty_valid -= self._dirty_full
             self._dirty_full.clear()
         if self._dirty_valid:
-            rows = sorted(self._dirty_valid)
-            for lo in range(0, len(rows), 64):
-                chunk = rows[lo:lo + 64]
-                m = 8 if len(chunk) <= 8 else 64
-                idx = np.full(m, chunk[0], dtype=np.int32)
-                idx[:len(chunk)] = chunk
-                data = self.valid[idx]
-                self.upload_bytes += idx.nbytes + data.nbytes
-                self.upload_bytes_by_field["range_valid"] += \
-                    idx.nbytes + data.nbytes
+            from accord_tpu.ops.deltas import flush_lane
+
+            def account(nbytes: int, m: int) -> None:
+                self.upload_bytes += nbytes
+                self.upload_bytes_by_field["range_valid"] += nbytes
                 # all-lanes baseline: the same chunk as a full range_scatter
                 self.upload_bytes_full_equiv += m * 29
-                d = list(self._device)
-                d[4] = scatter_rows(d[4], jnp.asarray(idx),
-                                    jnp.asarray(data))
-                self._device = tuple(d)
+
+            d = list(self._device)
+            d[4] = flush_lane(d[4], sorted(self._dirty_valid), self.valid,
+                              account)
+            self._device = tuple(d)
             self._dirty_valid.clear()
         return self._device
 
@@ -1184,12 +1162,45 @@ class _Call:
         return stalled
 
 
+class _Plan:
+    """One ENCODED-BUT-NOT-LAUNCHED dispatch (the staged tick pipeline's
+    hand-off between stage_host and stage_dispatch): the deferred kernel
+    launches -- closures over the plan-time arena snapshots and the
+    already-uploaded subject arrays -- plus the items/groups the harvest
+    will decode. jax arrays are immutable, so the snapshots captured at
+    encode time are frozen: scatters, growth, and compaction after the plan
+    is cut all build NEW device arrays, and the deferred launch still runs
+    against exactly the state this tick's preaccept registrations produced.
+    `empty` plans (nothing on device to conflict with) carry no launches
+    but still flow through the pipeline so floors and fallbacks inject at
+    harvest."""
+
+    __slots__ = ("items", "groups", "key_call", "range_call", "empty")
+
+    def __init__(self, items: List[_Item], groups: List[_Group],
+                 empty: bool = False):
+        self.items = items
+        self.groups = groups
+        self.key_call = None        # () -> packed, or None
+        self.range_call = None      # () -> (rpacked, kpacked), or None
+        self.empty = empty
+
+
 class BatchDepsResolver(DepsResolver):
     MAX_DISPATCH = 128  # subjects per kernel call (a named, warmable jit tier)
 
     def __init__(self, num_buckets: int = 256, initial_cap: int = 4096,
                  max_dispatch: Optional[int] = None,
-                 fuse_cross_store: bool = True):
+                 fuse_cross_store: bool = True,
+                 overlap_host: bool = True,
+                 pad_store_tiers: Optional[int] = None):
+        # the range kernel's covered-bucket contraction reduces intervals
+        # modulo the bucket count with int32 arithmetic; that wrap is exact
+        # only when num_buckets divides 2^32
+        Invariants.check_argument(
+            num_buckets > 0 and num_buckets & (num_buckets - 1) == 0,
+            "num_buckets %s must be a power of two (covered-bucket "
+            "contraction relies on int32 modular wrap)", num_buckets)
         # each dispatch pays one interconnect round trip at harvest, so on
         # high-latency links (the tunnelled bench chip) larger dispatches
         # amortize it; the default stays small to bound jit tiers in tests
@@ -1198,6 +1209,16 @@ class BatchDepsResolver(DepsResolver):
         # kernel call. False: one dispatch per store per tick -- the
         # differential baseline the fused path is tested bit-identical to
         self.fuse_cross_store = fuse_cross_store
+        # True (default): staged tick pipeline -- each tick launches the
+        # PREVIOUS tick's encoded plans first, then preaccepts/encodes the
+        # next batch while that call is in flight, hiding host work inside
+        # the device window. False: today's serial tick (preaccept -> encode
+        # -> launch in one event), the bit-identical differential baseline.
+        self.overlap_host = overlap_host
+        # opt-in: pad fused cross-store dispatches to a fixed store tier
+        # with cached empty arena blocks so many-store nodes compile ONE
+        # jit tier instead of one per participating-store count
+        self.pad_store_tiers = pad_store_tiers
         import jax.numpy as jnp
         self.num_buckets = num_buckets
         self.initial_cap = initial_cap
@@ -1211,13 +1232,28 @@ class BatchDepsResolver(DepsResolver):
         # exactly one harvest event, which pops the head
         self._inflight: Dict[int, "deque[_Call]"] = {}
         self._polling: set = set()
+        # per-node encode-ahead stage: plans cut by the last tick's
+        # stage_host, launched by the NEXT tick's stage_dispatch
+        self._staged: Dict[int, List[_Plan]] = {}
+        # last batch window seen per node, for the self-armed launch tick
+        self._windows: Dict[int, float] = {}
+        # cached empty arena blocks for pad_store_tiers
+        self._pad_key = None
+        self._pad_range = None
         # bench counters
         self.dispatches = 0
         self.subjects = 0
         self.ticks = 0               # node ticks that produced any items
+        self.preaccept_s = 0.0       # host preaccept transitions (stage_host)
         self.encode_s = 0.0          # host-side upload-array build + enqueue
+        self.dispatch_s = 0.0        # kernel launch + readback enqueue
         self.harvest_stall_s = 0.0   # blocking on the async transfer
         self.decode_s = 0.0          # host-side result materialization
+        self.host_hidden_s = 0.0     # host phase time spent while >=1 call
+        #                              was in flight (overlapped = hidden)
+        self.staged_dispatches = 0   # launches that came off the staged list
+        self.padded_dispatches = 0   # fused call sides topped up to
+        #                              pad_store_tiers with empty blocks
         self.prefetched = 0          # harvests whose transfer the poll drained
         self.polls_armed = 0         # readiness polls armed (device_poll_ms)
         self.stale_harvests = 0      # calls translated across a compaction
@@ -1228,6 +1264,15 @@ class BatchDepsResolver(DepsResolver):
         # initial _RangeArena capacity (the sharded resolver widens it to
         # keep rcap % (32*data) == 0)
         self.range_cap = 64
+
+    @property
+    def host_hidden_pct(self) -> float:
+        """Share of total host-phase wall time (preaccept + encode + launch
+        + decode) that ran while a device call was already in flight -- the
+        fraction the staged pipeline hid inside the device window."""
+        total = (self.preaccept_s + self.encode_s + self.dispatch_s
+                 + self.decode_s)
+        return 100.0 * self.host_hidden_s / total if total > 0.0 else 0.0
 
     @property
     def upload_bytes(self) -> int:
@@ -1324,21 +1369,67 @@ class BatchDepsResolver(DepsResolver):
 
     def _schedule_tick(self, store) -> None:
         node = store.node
+        self._windows[id(node)] = store.batch_window_ms
         if id(node) in self._ticking:
             return
         self._ticking.add(id(node))
         node.scheduler.once(store.batch_window_ms, lambda: self._tick(node))
 
+    def _arm_tick(self, node) -> None:
+        """Self-arm the next tick so staged plans launch even when no new
+        enqueue arrives to schedule one."""
+        if id(node) in self._ticking:
+            return
+        self._ticking.add(id(node))
+        window = self._windows.get(id(node)) or 0.0
+        node.scheduler.once(window, lambda: self._tick(node))
+
     def _tick(self, node) -> None:
+        """One node tick. Serial mode (overlap_host=False) runs preaccept ->
+        encode -> launch in this one event, exactly the pre-pipeline
+        behavior. Staged mode reorders the event into stage_dispatch first
+        (launch the PREVIOUS tick's encoded plans, putting the device to
+        work immediately) then stage_host (preaccept + encode the batch
+        drained now, staged for the NEXT tick's launch) -- so the host
+        phases below run in the wall-clock shadow of the in-flight call.
+        stage_decode stays on the harvest event, which fires per dispatch
+        after device_latency_ms and drains in dispatch order."""
+        import time as _time
+        self._ticking.discard(id(node))
+        if not self.overlap_host:
+            items = self._drain_and_preaccept(node)
+            for sub in self._slices(items):
+                self._dispatch(node, sub)
+            return
+        # STAGE_DISPATCH: launch before any host work this event does
+        for plan in self._staged.pop(id(node), []):
+            self._launch(node, plan, staged=True)
+        # STAGE_HOST: preaccept transitions + arena registration + upload-
+        # array build for the NEXT tick's launch. Registrations land in the
+        # arena before _encode_plan cuts each plan's field-granular delta
+        # upload, so batchmates still witness each other.
+        t0 = _time.perf_counter()
+        items = self._drain_and_preaccept(node)
+        plans = [self._stage(node, sub) for sub in self._slices(items)]
+        if self._inflight.get(id(node)):
+            self.host_hidden_s += _time.perf_counter() - t0
+        if plans:
+            self._staged[id(node)] = plans
+            self._arm_tick(node)
+
+    def _drain_and_preaccept(self, node) -> List[_Item]:
+        """Pop the node's enqueued work and run the host preaccept phase:
+        registrations land in the arena immediately, so batchmates witness
+        each other (deps may be any conservative superset; execution still
+        orders by executeAt). A preaccept that raises fails ONLY its own
+        AsyncResult -- the rest of the batch, and the pipeline, proceed."""
+        import time as _time
         from accord_tpu.local import commands
         from accord_tpu.local.commands import AcceptOutcome
-        self._ticking.discard(id(node))
         pa = self._pa_queues.pop(id(node), [])
         dq = self._deps_queues.pop(id(node), [])
         items: List[_Item] = []
-        # host preaccept phase: registrations land in the arena immediately,
-        # so batchmates witness each other (deps may be any conservative
-        # superset; execution still orders by executeAt)
+        t0 = _time.perf_counter()
         for (store, t, p, route, ballot, out) in pa:
             try:
                 outcome = commands.preaccept(store, t, p, route, ballot)
@@ -1351,32 +1442,55 @@ class BatchDepsResolver(DepsResolver):
                 continue
             items.append(_Item(store, t, store.owned(p.keys),
                                store.command(t).execute_at, out, outcome))
+        self.preaccept_s += _time.perf_counter() - t0
         for (store, t, ks, before, out) in dq:
             items.append(_Item(store, t, store.owned(ks), before, out))
         if items:
             self.ticks += 1
+        return items
+
+    def _slices(self, items: List[_Item]) -> List[List[_Item]]:
+        """Split a tick's items into dispatch slices. Fused (default): ONE
+        device call per tick slice, every store's items riding together;
+        oversized batches split so subject jit tiers stay bounded
+        (8..max_dispatch). Unfused: one dispatch per store per tick -- the
+        fused path's differential baseline."""
         if self.fuse_cross_store:
-            # ONE fused device call per tick (per max_dispatch slice):
-            # every store's pending items ride together; split oversized
-            # batches so subject jit tiers stay bounded (8..max_dispatch)
-            for lo in range(0, len(items), self.max_dispatch):
-                self._dispatch(node, items[lo:lo + self.max_dispatch])
-        else:
-            # per-store dispatch: the fused path's differential baseline
-            by_store: Dict[int, List[_Item]] = {}
-            for item in items:
-                by_store.setdefault(id(item.store), []).append(item)
-            for sub in by_store.values():
-                for lo in range(0, len(sub), self.max_dispatch):
-                    self._dispatch(node, sub[lo:lo + self.max_dispatch])
+            return [items[lo:lo + self.max_dispatch]
+                    for lo in range(0, len(items), self.max_dispatch)]
+        by_store: Dict[int, List[_Item]] = {}
+        for item in items:
+            by_store.setdefault(id(item.store), []).append(item)
+        return [sub[lo:lo + self.max_dispatch]
+                for sub in by_store.values()
+                for lo in range(0, len(sub), self.max_dispatch)]
 
     def _encode_and_run(self, groups: List[_Group], items: List[_Item]):
-        """Build the flat CSR upload arrays and run the fused kernels for
-        one dispatch spanning one or more STORE groups. Shared by the async
-        dispatch and the sync path -- the two must never drift. Returns
-        (packed, rpacked, kpacked) device arrays (each may be None when that
-        kernel had nothing to do) and records each group's word-column spans
-        (the row-offset table) for decode routing.
+        """Encode + launch back to back (the sync `resolve_batch` path, and
+        the composition the staged pipeline splits in two)."""
+        return self._run_plan(self._encode_plan(groups, items, pin=False))
+
+    def _run_plan(self, plan: _Plan):
+        """stage_dispatch: fire a plan's deferred kernel launches against
+        its plan-time snapshots. Returns (packed, rpacked, kpacked) device
+        arrays, each None when that kernel had nothing to do."""
+        packed = plan.key_call() if plan.key_call is not None else None
+        rpacked = kpacked = None
+        if plan.range_call is not None:
+            rpacked, kpacked = plan.range_call()
+        return packed, rpacked, kpacked
+
+    def _encode_plan(self, groups: List[_Group], items: List[_Item],
+                     pin: bool = True) -> _Plan:
+        """Build the flat CSR upload arrays for one dispatch spanning one
+        or more STORE groups and return a _Plan whose deferred calls run
+        the fused kernels against snapshots captured NOW. Shared by the
+        async dispatch and the sync path -- the two must never drift. Each
+        group's word-column spans (the row-offset table) are recorded from
+        the snapshot shapes for decode routing, and (pin=True) the
+        generation pins the harvest will need are taken at plan time, so a
+        compaction landing between encode-ahead and launch is translated
+        like any other stale harvest.
 
         Key-domain subjects upload one (subject row, key bucket) CSR entry
         per owned key -- variable width, so arbitrarily wide subjects stay
@@ -1440,7 +1554,7 @@ class BatchDepsResolver(DepsResolver):
                         continue
                     givs[gi].extend((i, s, e) for (s, e) in ivs)
         # -- key-domain kernel plan --------------------------------------
-        packed = None
+        plan = _Plan(items, groups)
         k_parts = [(gi, g) for gi, g in enumerate(groups)
                    if g.arena.count > 0 and gkeys[gi]]
         if k_parts:
@@ -1462,25 +1576,34 @@ class BatchDepsResolver(DepsResolver):
                     np.int64, total) % self.num_buckets).astype(np.int32)
             if len(groups) == 1:
                 g = groups[0]
-                packed = self._run_kernel(
-                    g.arena, jnp.asarray(subj_of), jnp.asarray(subj_keys),
-                    jnp.asarray(sb), jnp.asarray(sknd))
-                g.pk = (0, g.arena.cap // 32)
+                ksnap = g.arena.device_arrays()
+                g.pk = (0, ksnap[0].shape[0] // 32)
+                j_of, j_keys = jnp.asarray(subj_of), jnp.asarray(subj_keys)
+                j_sb, j_sknd = jnp.asarray(sb), jnp.asarray(sknd)
+                plan.key_call = (
+                    lambda ksnap=ksnap, j_of=j_of, j_keys=j_keys,
+                    j_sb=j_sb, j_sknd=j_sknd:
+                    self._run_kernel(ksnap, j_of, j_keys, j_sb, j_sknd))
             else:
                 slots = np.fromiter((gi for gi, _ in k_parts), np.int64,
                                     len(k_parts)).astype(np.int32)
-                packed = self._run_fused_kernel(
-                    [g for _, g in k_parts], jnp.asarray(slots),
-                    jnp.asarray(subj_of), jnp.asarray(subj_keys),
-                    jnp.asarray(subj_store), jnp.asarray(sb),
-                    jnp.asarray(sknd))
-                off = 0
+                ksnaps, off = [], 0
                 for _, g in k_parts:
-                    w = g.arena.cap // 32
+                    snap = g.arena.device_arrays()
+                    ksnaps.append(snap)
+                    w = snap[0].shape[0] // 32
                     g.pk = (off, off + w)
                     off += w
+                j_slots = jnp.asarray(slots)
+                j_of, j_keys = jnp.asarray(subj_of), jnp.asarray(subj_keys)
+                j_store = jnp.asarray(subj_store)
+                j_sb, j_sknd = jnp.asarray(sb), jnp.asarray(sknd)
+                plan.key_call = (
+                    lambda ksnaps=ksnaps, j_slots=j_slots, j_of=j_of,
+                    j_keys=j_keys, j_store=j_store, j_sb=j_sb, j_sknd=j_sknd:
+                    self._run_fused_kernel(ksnaps, j_slots, j_of, j_keys,
+                                           j_store, j_sb, j_sknd))
         # -- range kernel plan -------------------------------------------
-        rpacked = kpacked = None
         intervals = [t for gv in givs for t in gv]
         r_parts = [(gi, g) for gi, g in enumerate(groups)
                    if g.arena.ranges.count > 0 and g.arena.ranges.encode_ok
@@ -1496,88 +1619,148 @@ class BatchDepsResolver(DepsResolver):
             iv_of[:len(intervals)] = arr[:, 0]
             iv_s[:len(intervals)] = arr[:, 1]
             iv_e[:len(intervals)] = arr[:, 2]
+            j_iv = (jnp.asarray(iv_of), jnp.asarray(iv_s),
+                    jnp.asarray(iv_e))
+            j_sb, j_sknd = jnp.asarray(sb), jnp.asarray(sknd)
+            j_srng = jnp.asarray(srng)
             if len(groups) == 1:
                 g = groups[0]
-                rpacked, kpacked = self._run_range_kernel(
-                    g.arena, jnp.asarray(iv_of), jnp.asarray(iv_s),
-                    jnp.asarray(iv_e), jnp.asarray(sb), jnp.asarray(sknd),
-                    jnp.asarray(srng))
-                g.rp = (0, g.arena.ranges.cap // 32)
-                g.kp = (0, g.arena.cap // 32)
+                rsnap = g.arena.ranges.device_arrays()
+                ksnap = g.arena.device_arrays()
+                g.rp = (0, rsnap[0].shape[0] // 32)
+                g.kp = (0, ksnap[0].shape[0] // 32)
+                plan.range_call = (
+                    lambda rsnap=rsnap, ksnap=ksnap, j_iv=j_iv, j_sb=j_sb,
+                    j_sknd=j_sknd, j_srng=j_srng:
+                    self._run_range_kernel(rsnap, ksnap, j_iv[0], j_iv[1],
+                                           j_iv[2], j_sb, j_sknd, j_srng))
             else:
                 r_slots = np.fromiter((gi for gi, _ in r_parts), np.int64,
                                       len(r_parts)).astype(np.int32)
                 k_slots = np.fromiter((gi for gi, _ in h_parts), np.int64,
                                       len(h_parts)).astype(np.int32)
-                rpacked, kpacked = self._run_fused_range_kernel(
-                    [g for _, g in r_parts], jnp.asarray(r_slots),
-                    [g for _, g in h_parts], jnp.asarray(k_slots),
-                    jnp.asarray(iv_of), jnp.asarray(iv_s),
-                    jnp.asarray(iv_e), jnp.asarray(subj_store),
-                    jnp.asarray(sb), jnp.asarray(sknd), jnp.asarray(srng))
-                if r_parts:
-                    off = 0
-                    for _, g in r_parts:
-                        w = g.arena.ranges.cap // 32
-                        g.rp = (off, off + w)
-                        off += w
-                else:
-                    rpacked = None
-                if h_parts:
-                    off = 0
-                    for _, g in h_parts:
-                        w = g.arena.cap // 32
-                        g.kp = (off, off + w)
-                        off += w
-                else:
-                    kpacked = None
-        return packed, rpacked, kpacked
+                rsnaps, off = [], 0
+                for _, g in r_parts:
+                    snap = g.arena.ranges.device_arrays()
+                    rsnaps.append(snap)
+                    w = snap[0].shape[0] // 32
+                    g.rp = (off, off + w)
+                    off += w
+                ksnaps, off = [], 0
+                for _, g in h_parts:
+                    snap = g.arena.device_arrays()
+                    ksnaps.append(snap)
+                    w = snap[0].shape[0] // 32
+                    g.kp = (off, off + w)
+                    off += w
+                j_rsl, j_ksl = jnp.asarray(r_slots), jnp.asarray(k_slots)
+                j_store = jnp.asarray(subj_store)
+                has_r, has_k = bool(r_parts), bool(h_parts)
 
-    def _run_kernel(self, arena: "_StoreArena", subj_of, subj_keys, sb,
-                    sknd):
-        """The single-store kernel call; ShardedBatchDepsResolver overrides
+                def range_call(rsnaps=rsnaps, ksnaps=ksnaps, j_rsl=j_rsl,
+                               j_ksl=j_ksl, j_iv=j_iv, j_store=j_store,
+                               j_sb=j_sb, j_sknd=j_sknd, j_srng=j_srng,
+                               has_r=has_r, has_k=has_k):
+                    rp, kp = self._run_fused_range_kernel(
+                        rsnaps, j_rsl, ksnaps, j_ksl, j_iv[0], j_iv[1],
+                        j_iv[2], j_store, j_sb, j_sknd, j_srng)
+                    return (rp if has_r else None, kp if has_k else None)
+
+                plan.range_call = range_call
+        if pin:
+            for g in groups:
+                if g.pk is not None or g.kp is not None:
+                    g.arena.pin_gen()
+                    g.pinned = True
+                if g.rp is not None:
+                    g.arena.ranges.pin_gen()
+                    g.rpinned = True
+        return plan
+
+    def _run_kernel(self, ksnap, subj_of, subj_keys, sb, sknd):
+        """The single-store kernel call against a plan-time arena snapshot
+        (bm, ts, exec_ts, kinds, valid); ShardedBatchDepsResolver overrides
         this to run the same computation sharded over a device mesh."""
         from accord_tpu.ops.kernels import deps_resolve
-        act_bm, act_ts, _, act_kinds, _, _, act_valid = arena.device_arrays()
+        act_bm, act_ts, _, act_kinds, act_valid = ksnap
         return deps_resolve(subj_of, subj_keys, sb, sknd,
                             act_bm, act_ts, act_kinds, act_valid, self._table)
 
-    def _run_range_kernel(self, arena: "_StoreArena", iv_of, iv_s, iv_e,
+    def _run_range_kernel(self, rsnap, ksnap, iv_of, iv_s, iv_e,
                           sb, sknd, srng):
         from accord_tpu.ops.kernels import range_deps_resolve
-        r_start, r_end, r_ts, r_kinds, r_valid = \
-            arena.ranges.device_arrays()
-        _, k_ts, _, k_kinds, k_kmin, k_kmax, k_valid = arena.device_arrays()
+        r_start, r_end, r_ts, r_kinds, r_valid = rsnap
+        k_bm, k_ts, _, k_kinds, k_valid = ksnap
         return range_deps_resolve(iv_of, iv_s, iv_e, sb, sknd, srng,
                                   r_start, r_end, r_ts, r_kinds, r_valid,
-                                  k_kmin, k_kmax, k_ts, k_kinds, k_valid,
+                                  k_bm, k_ts, k_kinds, k_valid,
                                   self._table)
 
-    def _run_fused_kernel(self, kgroups: List[_Group], slots, subj_of,
-                          subj_keys, subj_store, sb, sknd):
+    # -- pad_store_tiers helpers ----------------------------------------------
+    def _pad_key_block(self):
+        """Cached all-invalid key-arena block for pad_store_tiers, shaped
+        like a fresh arena so padded dispatches share the max-tier compiled
+        shape. Invalid rows contribute nothing, and the dummy word columns
+        sit beyond every real group's span, so decode never sees them."""
+        if self._pad_key is None:
+            import jax.numpy as jnp
+            cap = self.initial_cap
+            self._pad_key = (
+                jnp.zeros((cap, self.num_buckets), jnp.float32),
+                jnp.zeros((cap, 3), jnp.int32),
+                jnp.zeros(cap, jnp.int32),
+                jnp.zeros(cap, bool))
+        return self._pad_key
+
+    def _pad_range_block(self):
+        if self._pad_range is None:
+            import jax.numpy as jnp
+            rc = self.range_cap
+            self._pad_range = (
+                jnp.zeros(rc, jnp.int32), jnp.zeros(rc, jnp.int32),
+                jnp.zeros((rc, 3), jnp.int32), jnp.zeros(rc, jnp.int32),
+                jnp.zeros(rc, bool))
+        return self._pad_range
+
+    def _pad_fused(self, blocks: list, slots, pad_block):
+        """pad_store_tiers: top a fused call's block list up to the fixed
+        store tier with cached empty blocks under slot -1 (no subject's
+        store-id lane is negative, so dummies match nothing). Trades a
+        little extra readback width per dummy for ONE compiled jit tier
+        across all participating-store counts up to the tier."""
+        tier = self.pad_store_tiers
+        if not tier or len(blocks) >= tier:
+            return slots
+        import jax.numpy as jnp
+        pad = pad_block()
+        npad = tier - len(blocks)
+        blocks.extend([pad] * npad)
+        self.padded_dispatches += 1
+        return jnp.concatenate([slots, jnp.full(npad, -1, jnp.int32)])
+
+    def _run_fused_kernel(self, ksnaps, slots, subj_of, subj_keys,
+                          subj_store, sb, sknd):
         """The fused cross-store key kernel: every participating store's
-        arena lanes enter one call as a tuple block; ShardedBatchDepsResolver
-        overrides this to run it over the mesh."""
+        snapshot lanes enter one call as a tuple block; the
+        ShardedBatchDepsResolver override runs it over the mesh."""
         from accord_tpu.ops.kernels import fused_deps_resolve
-        arenas = []
-        for g in kgroups:
-            bm, ts, _, kinds, _, _, valid = g.arena.device_arrays()
-            arenas.append((bm, ts, kinds, valid))
+        arenas = [(bm, ts, kinds, valid)
+                  for (bm, ts, _, kinds, valid) in ksnaps]
+        slots = self._pad_fused(arenas, slots, self._pad_key_block)
         return fused_deps_resolve(subj_of, subj_keys, subj_store, sb, sknd,
                                   slots, tuple(arenas), self._table)
 
-    def _run_fused_range_kernel(self, rgroups: List[_Group], r_slots,
-                                kgroups: List[_Group], k_slots,
+    def _run_fused_range_kernel(self, rsnaps, r_slots, ksnaps, k_slots,
                                 iv_of, iv_s, iv_e, subj_store, sb, sknd,
                                 srng):
         from accord_tpu.ops.kernels import fused_range_deps_resolve
-        rarenas = tuple(g.arena.ranges.device_arrays() for g in rgroups)
-        karenas = []
-        for g in kgroups:
-            _, ts, _, kinds, kmin, kmax, valid = g.arena.device_arrays()
-            karenas.append((kmin, kmax, ts, kinds, valid))
+        rarenas = list(rsnaps)
+        r_slots = self._pad_fused(rarenas, r_slots, self._pad_range_block)
+        karenas = [(bm, ts, kinds, valid)
+                   for (bm, ts, _, kinds, valid) in ksnaps]
+        k_slots = self._pad_fused(karenas, k_slots, self._pad_key_block)
         return fused_range_deps_resolve(iv_of, iv_s, iv_e, subj_store, sb,
-                                        sknd, srng, r_slots, rarenas,
+                                        sknd, srng, r_slots, tuple(rarenas),
                                         k_slots, tuple(karenas), self._table)
 
     def _decode_batch(self, arena: _StoreArena, items: List[_Item],
@@ -1602,7 +1785,11 @@ class BatchDepsResolver(DepsResolver):
         # 2. clear each subject's own row bit (self is never a dep)
         srows = np.fromiter((arena.row_of.get(item.txn_id, -1)
                              for item in items), np.int64, n)
-        has_self = np.nonzero(srows >= 0)[0]
+        # rows past the snapshot width exist only when the arena grew after
+        # the plan was cut (staged encode-ahead): the kernel never saw them,
+        # so there is no self bit to clear
+        has_self = np.nonzero((srows >= 0)
+                              & (srows < item_packed.shape[1] * 32))[0]
         if has_self.size:
             r = srows[has_self]
             item_packed[has_self, r >> 5] &= \
@@ -1885,7 +2072,11 @@ class BatchDepsResolver(DepsResolver):
                                             item.before)
                 for item, d in zip(call.items, self._decode_core(call))]
 
-    def _dispatch(self, node, items: List[_Item]) -> None:
+    def _stage(self, node, items: List[_Item]) -> _Plan:
+        """stage_host's encode half: group one dispatch slice by store and
+        cut its plan (upload arrays + snapshots + plan-time generation
+        pins). The plan launches now (serial mode) or on the next tick's
+        stage_dispatch (overlap mode)."""
         import time as _time
         # ensure adoption of late-attached stores BEFORE snapshotting group
         # generations -- adoption may mutate (and compact) an arena
@@ -1906,30 +2097,54 @@ class BatchDepsResolver(DepsResolver):
             # nothing on device to conflict with (and possibly no encoder
             # yet): an empty call still flows through the pipeline so floors
             # and fallbacks are injected at harvest
-            call = _Call(None, None, None, items, groups)
+            return _Plan(items, groups, empty=True)
+        t0 = _time.perf_counter()
+        plan = self._encode_plan(groups, items)
+        self.encode_s += _time.perf_counter() - t0
+        return plan
+
+    def _launch(self, node, plan: _Plan, staged: bool = False) -> None:
+        """stage_dispatch: fire a plan's kernels (generation pins were
+        already taken at plan time, matched by unpin_gen in _harvest),
+        enqueue the async readback, and schedule the harvest."""
+        import time as _time
+        if plan.empty:
+            call = _Call(None, None, None, plan.items, plan.groups)
         else:
             t0 = _time.perf_counter()
-            packed, rpacked, kpacked = self._encode_and_run(groups, items)
+            packed, rpacked, kpacked = self._run_plan(plan)
             for buf in (packed, rpacked, kpacked):
                 if buf is not None:
                     buf.copy_to_host_async()
-            self.encode_s += _time.perf_counter() - t0
-            call = _Call(packed, rpacked, kpacked, items, groups)
-            # matched by unpin_gen in _harvest; kp spans address the KEY
-            # arena, so either key-domain span pins the key snapshot
-            for g in groups:
-                if g.pk is not None or g.kp is not None:
-                    g.arena.pin_gen()
-                    g.pinned = True
-                if g.rp is not None:
-                    g.arena.ranges.pin_gen()
-                    g.rpinned = True
+            self.dispatch_s += _time.perf_counter() - t0
+            call = _Call(packed, rpacked, kpacked, plan.items, plan.groups)
         self.dispatches += 1
-        self.subjects += len(items)
+        if staged:
+            self.staged_dispatches += 1
+        self.subjects += len(plan.items)
         self._inflight.setdefault(id(node), deque()).append(call)
         delay = getattr(node, "device_latency_ms", 4.0)
         node.scheduler.once(delay, lambda: self._harvest(node))
         self._ensure_poll(node)
+
+    def _dispatch(self, node, items: List[_Item]) -> None:
+        """Serial encode+launch of one dispatch slice in a single step (the
+        overlap_host=False tick path and the drain fallback)."""
+        self._launch(node, self._stage(node, items))
+
+    def drain(self, node) -> None:
+        """Flush the node's pipeline end to end (graceful shutdown): launch
+        any encode-ahead plans, run queued-but-unticked items straight
+        through serially, then blocking-harvest every in-flight call so no
+        AsyncResult strands once the scheduler stops delivering events."""
+        for plan in self._staged.pop(id(node), []):
+            self._launch(node, plan, staged=True)
+        items = self._drain_and_preaccept(node)
+        for sub in self._slices(items):
+            self._dispatch(node, sub)
+        q = self._inflight.get(id(node))
+        while q:
+            self._harvest(node)
 
     def _ensure_poll(self, node) -> None:
         """Arm the per-node readiness poll (if the scheduler supports it):
@@ -1992,7 +2207,12 @@ class BatchDepsResolver(DepsResolver):
                 g.arena.unpin_gen(g.gen)
             if g.rpinned:
                 g.arena.ranges.unpin_gen(g.rgen)
-        self.decode_s += _time.perf_counter() - t0
+        dt = _time.perf_counter() - t0
+        self.decode_s += dt
+        if q:
+            # calls still in flight behind this one: stage_decode ran
+            # inside their device window
+            self.host_hidden_s += dt
         for item, deps in zip(call.items, results):
             if item.outcome is not None:
                 item.out.try_set_success((item.outcome, item.before, deps))
@@ -2065,7 +2285,7 @@ class BatchDepsResolver(DepsResolver):
         padded_b = bucket_size(b)
         bitmaps = encode_key_bitmaps([tuple(kk) for _, kk in subjects],
                                      self.num_buckets)
-        act_bm, _, act_exec, _, _, _, act_valid = arena.device_arrays()
+        act_bm, _, act_exec, _, act_valid = arena.device_arrays()
         # registered rows count even when invalidated (MaxConflicts is
         # monotone in the reference); valid lane is NOT applied here
         all_rows = jnp.ones_like(act_valid)
@@ -2103,84 +2323,75 @@ class ShardedBatchDepsResolver(BatchDepsResolver):
     the arrays LIVE sharded and the per-call movement is dirty rows only."""
 
     def __init__(self, mesh=None, num_buckets: int = 256,
-                 initial_cap: int = 4096, fuse_cross_store: bool = True):
+                 initial_cap: int = 4096, fuse_cross_store: bool = True,
+                 overlap_host: bool = True,
+                 pad_store_tiers: Optional[int] = None):
         super().__init__(num_buckets, initial_cap,
-                         fuse_cross_store=fuse_cross_store)
+                         fuse_cross_store=fuse_cross_store,
+                         overlap_host=overlap_host,
+                         pad_store_tiers=pad_store_tiers)
         from accord_tpu.parallel.mesh import make_mesh
         self.mesh = mesh if mesh is not None else make_mesh()
         data = self.mesh.shape["data"]
         model = self.mesh.shape["model"]
-        # both contracts survive arena doubling
+        # both contracts survive arena doubling (the power-of-two bucket
+        # count the contraction needs is asserted by the base class)
         Invariants.check_argument(
             initial_cap % (32 * data) == 0,
             "arena cap %s not divisible by 32*data(%s)", initial_cap, data)
         Invariants.check_argument(
             num_buckets % model == 0,
             "num_buckets %s not divisible by model(%s)", num_buckets, model)
-        # the sharded range kernel contracts the key-arena hull test over
-        # 'model' buckets with int32 modular arithmetic, exact only when
-        # the bucket count divides 2^32
-        Invariants.check_argument(
-            num_buckets & (num_buckets - 1) == 0,
-            "num_buckets %s not a power of two (the sharded bucket "
-            "contraction's int32 modular hull test requires it)",
-            num_buckets)
         # the range arena shards its rows over 'data' too, so its capacity
         # must honor the same 32*data packing contract (GROW=2 preserves it)
         self.range_cap = max(64, 32 * data)
 
-    def _run_kernel(self, arena: _StoreArena, subj_of, subj_keys, sb, sknd):
+    def _run_kernel(self, ksnap, subj_of, subj_keys, sb, sknd):
         # sharded_deps_resolve is lru_cached by mesh: every resolver (one
         # per node in a burn) shares one compiled kernel
         from accord_tpu.parallel.mesh import sharded_deps_resolve
         kern = sharded_deps_resolve(self.mesh)
-        act_bm, act_ts, _, act_kinds, _, _, act_valid = arena.device_arrays()
+        act_bm, act_ts, _, act_kinds, act_valid = ksnap
         return kern(subj_of, subj_keys, sb, sknd,
                     act_bm, act_ts, act_kinds, act_valid, self._table)
 
-    def _run_range_kernel(self, arena: _StoreArena, iv_of, iv_s, iv_e,
+    def _run_range_kernel(self, rsnap, ksnap, iv_of, iv_s, iv_e,
                           sb, sknd, srng):
-        # the key-side hull test runs bucket-contracted over 'model': the
-        # subject intervals scatter into local bucket coverage and the key
-        # bitmap contracts against it, so the kmin/kmax row lanes never
-        # replicate across the mesh (host decode re-filters per real key,
-        # so the conservative coverage superset stays exact end to end)
+        # the key-side coverage test runs bucket-contracted over 'model':
+        # the subject intervals scatter into local bucket coverage and the
+        # key bitmap contracts against it (host decode re-filters per real
+        # key, so the conservative coverage superset stays exact end to end)
         from accord_tpu.parallel.mesh import sharded_range_deps_resolve
         kern = sharded_range_deps_resolve(self.mesh)
-        r_start, r_end, r_ts, r_kinds, r_valid = \
-            arena.ranges.device_arrays()
-        act_bm, k_ts, _, k_kinds, _, _, k_valid = arena.device_arrays()
+        r_start, r_end, r_ts, r_kinds, r_valid = rsnap
+        act_bm, k_ts, _, k_kinds, k_valid = ksnap
         return kern(iv_of, iv_s, iv_e, sb, sknd, srng,
                     r_start, r_end, r_ts, r_kinds, r_valid,
                     act_bm, k_ts, k_kinds, k_valid, self._table)
 
-    def _run_fused_kernel(self, kgroups: List[_Group], slots, subj_of,
-                          subj_keys, subj_store, sb, sknd):
+    def _run_fused_kernel(self, ksnaps, slots, subj_of, subj_keys,
+                          subj_store, sb, sknd):
         # lru_cached by (mesh, store count): all same-width fused dispatches
         # share one compiled kernel
         from accord_tpu.parallel.mesh import sharded_fused_deps_resolve
-        kern = sharded_fused_deps_resolve(self.mesh, len(kgroups))
-        arenas = []
-        for g in kgroups:
-            bm, ts, _, kinds, _, _, valid = g.arena.device_arrays()
-            arenas.append((bm, ts, kinds, valid))
+        arenas = [(bm, ts, kinds, valid)
+                  for (bm, ts, _, kinds, valid) in ksnaps]
+        slots = self._pad_fused(arenas, slots, self._pad_key_block)
+        kern = sharded_fused_deps_resolve(self.mesh, len(arenas))
         return kern(subj_of, subj_keys, subj_store, sb, sknd,
                     slots, tuple(arenas), self._table)
 
-    def _run_fused_range_kernel(self, rgroups: List[_Group], r_slots,
-                                kgroups: List[_Group], k_slots,
+    def _run_fused_range_kernel(self, rsnaps, r_slots, ksnaps, k_slots,
                                 iv_of, iv_s, iv_e, subj_store, sb, sknd,
                                 srng):
-        # the sharded fused karena lane set deliberately differs from the
-        # single-device one: (bm, ts, kinds, valid) for the bucket-contracted
-        # hull test instead of the replicated (kmin, kmax, ...) hull lanes
         from accord_tpu.parallel.mesh import sharded_fused_range_deps_resolve
-        kern = sharded_fused_range_deps_resolve(self.mesh, len(rgroups),
-                                                len(kgroups))
-        rarenas = tuple(g.arena.ranges.device_arrays() for g in rgroups)
-        karenas = []
-        for g in kgroups:
-            bm, ts, _, kinds, _, _, valid = g.arena.device_arrays()
-            karenas.append((bm, ts, kinds, valid))
+        rarenas = list(rsnaps)
+        r_slots = self._pad_fused(rarenas, r_slots, self._pad_range_block)
+        karenas = [(bm, ts, kinds, valid)
+                   for (bm, ts, _, kinds, valid) in ksnaps]
+        k_slots = self._pad_fused(karenas, k_slots, self._pad_key_block)
+        kern = sharded_fused_range_deps_resolve(self.mesh, len(rarenas),
+                                                len(karenas))
         return kern(iv_of, iv_s, iv_e, subj_store, sb, sknd, srng,
-                    r_slots, rarenas, k_slots, tuple(karenas), self._table)
+                    r_slots, tuple(rarenas), k_slots, tuple(karenas),
+                    self._table)

@@ -195,25 +195,18 @@ def _concat_lane_blocks(mesh: Mesh, blocks):
 
 def _covered_buckets(iv_of, iv_start, iv_end, b, k_local, model):
     """The subject intervals' bucket-coverage bitmap, restricted to THIS
-    'model' shard's bucket slice -> bf16[b, k_local]. A half-open interval
-    [s, e) of raw key tokens covers bucket j iff some v in [s, e) has
-    v mod K == j, i.e. (j - s) mod K < e - s. int32 subtraction wraps mod
-    2^32, which preserves residues mod K exactly when K divides 2^32 -- the
-    resolver asserts num_buckets is a power of two. Widths that overflow
-    int32 go negative (true width < 2^32 always), so `wide` catches both
-    them and genuinely-full intervals; coverage is a conservative superset
-    either way (the host decode re-filters per real key)."""
-    k_total = k_local * model
-    j = jax.lax.axis_index("model") * k_local \
-        + jnp.arange(k_local, dtype=jnp.int32)
-    width = iv_end - iv_start
-    wide = (width <= 0) | (width >= k_total)
-    covered = wide[:, None] | (
-        jnp.mod(j[None, :] - iv_start[:, None], k_total) < width[:, None])
-    # padding entries (iv_of == b) drop out of the scatter
-    return jnp.zeros((b, k_local), jnp.float32) \
-        .at[iv_of].max(covered.astype(jnp.float32), mode="drop") \
-        .astype(jnp.bfloat16)
+    'model' shard's bucket slice -> bf16[b, k_local]. Thin wrapper over the
+    shared kernels.covered_buckets modular test (the single-device range
+    kernel contracts over the same helper with base == 0): this shard covers
+    global buckets [axis_index * k_local, (axis_index + 1) * k_local).
+    Widths that overflow int32 go negative (true width < 2^32 always), so
+    the helper's `wide` branch catches both them and genuinely-full
+    intervals; coverage is a conservative superset either way (the host
+    decode re-filters per real key)."""
+    from accord_tpu.ops.kernels import covered_buckets
+    base = jax.lax.axis_index("model") * k_local
+    return covered_buckets(iv_of, iv_start, iv_end, b, k_local, base,
+                           k_local * model)
 
 
 @functools.lru_cache(maxsize=8)
@@ -224,14 +217,14 @@ def sharded_range_deps_resolve(mesh: Mesh):
     block). The key-side test CONTRACTS over 'model' buckets like
     sharded_deps_resolve: the subject intervals scatter into per-shard
     bucket coverage (_covered_buckets) and contract against the key bitmap
-    [cap, K] sharded ('data', 'model'), replacing the single-device kmin/
-    kmax hull lanes -- no key-arena row lane is replicated across 'model'.
-    Both packed outputs come back lane-sharded over 'data'; lane order
-    equals row order because rcap % (32 * data) == 0 and
+    [cap, K] sharded ('data', 'model') -- the same contraction the
+    single-device kernel now runs, so no key-arena row lane is replicated
+    across 'model'. Both packed outputs come back lane-sharded over 'data';
+    lane order equals row order because rcap % (32 * data) == 0 and
     cap % (32 * data) == 0 (the resolver's capacity contracts, preserved by
-    doubling). Bucket coverage and the hull are both conservative supersets
-    of the true key overlap; the host decode re-filters per real key, so
-    single-device and sharded answers stay differentially identical."""
+    doubling). Bucket coverage is a conservative superset of the true key
+    overlap; the host decode re-filters per real key, so single-device and
+    sharded answers stay differentially identical."""
     from accord_tpu.ops.kernels import _lex_before, _pack_bits
     model = mesh.shape["model"]
 

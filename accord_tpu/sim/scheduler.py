@@ -61,7 +61,14 @@ class NodeScheduler(SimScheduler):
     """Per-node facade with a kill switch: after a crash, the dead
     incarnation's timers (progress ticks, batch ticks, retries) must neither
     run nor re-arm -- a ghost node scheduling forever would both act on the
-    cluster and prevent quiescence."""
+    cluster and prevent quiescence.
+
+    The staged tick pipeline (ops/resolver.py) leans on this guard for its
+    self-armed launch ticks too: a crashed node's staged (encode-ahead)
+    plans and in-flight harvests simply never fire, matching the reference's
+    drop-everything crash semantics. Graceful stops instead call
+    Node.shutdown(), which drains both pipeline stages through the resolver
+    before the scheduler goes quiet."""
 
     def __init__(self, queue: PendingQueue, alive: list):
         super().__init__(queue)

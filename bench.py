@@ -176,9 +176,13 @@ def bench_pipeline(quick: bool):
     window = 2 * chunk      # >= 4 in-flight dispatches
     done = [0]
     failed = [0]
+    pa0 = resolver.preaccept_s
     enc0 = resolver.encode_s
+    disp0 = resolver.dispatch_s
     stall0 = resolver.harvest_stall_s
     dec0 = resolver.decode_s
+    hid0 = resolver.host_hidden_s
+    sd0 = resolver.staged_dispatches
     pre0 = resolver.prefetched
     stale0 = resolver.stale_harvests
     fall0 = resolver.host_fallbacks
@@ -221,6 +225,26 @@ def bench_pipeline(quick: bool):
         raise AssertionError(
             f"jit tiers compiled inside the timed window: {cache0} -> "
             f"{cache1} (warmup coverage is stale)")
+    # staged tick pipeline: launches must come off the encode-ahead list,
+    # and some host-phase time must have run inside the device window
+    staged_d = resolver.staged_dispatches - sd0
+    if staged_d <= 0:
+        raise AssertionError(
+            "staged pipeline disengaged in the large replay "
+            "(no encode-ahead launches)")
+    phase_s = {
+        "preaccept_s": resolver.preaccept_s - pa0,
+        "encode_s": resolver.encode_s - enc0,
+        "dispatch_s": resolver.dispatch_s - disp0,
+        "decode_s": resolver.decode_s - dec0,
+    }
+    hidden_s = resolver.host_hidden_s - hid0
+    phases_total = sum(phase_s.values())
+    host_hidden_pct = 100.0 * hidden_s / phases_total if phases_total else 0.0
+    if not hidden_s > 0:
+        raise AssertionError(
+            "no host-phase time was hidden inside the device window "
+            "(host_hidden_s delta is zero)")
     per_op = np.asarray(chunk_walls) / np.asarray(chunk_sizes) * 1e6
     host_projected_s = replay_ops * (host_mean / 1e6)
 
@@ -250,10 +274,17 @@ def bench_pipeline(quick: bool):
                 "p99": round(float(np.percentile(per_op, 99)), 1),
                 "p999": round(float(np.percentile(per_op, 99.9)), 1),
             },
-            # pipeline-stage costs over the replay (deltas)
-            "encode_s": round(resolver.encode_s - enc0, 2),
+            # pipeline-stage costs over the replay (deltas): the three host
+            # stages plus decode, and how much of that total ran while a
+            # device call was already in flight (hidden by the staged tick)
+            "preaccept_s": round(phase_s["preaccept_s"], 2),
+            "encode_s": round(phase_s["encode_s"], 2),
+            "dispatch_s": round(phase_s["dispatch_s"], 2),
+            "decode_s": round(phase_s["decode_s"], 2),
             "harvest_stall_s": round(resolver.harvest_stall_s - stall0, 2),
-            "decode_s": round(resolver.decode_s - dec0, 2),
+            "host_hidden_s": round(hidden_s, 2),
+            "host_hidden_pct": round(host_hidden_pct, 1),
+            "staged_dispatches": staged_d,
             "prefetched": resolver.prefetched - pre0,
             "stale_harvests": resolver.stale_harvests - stale0,
             "host_fallbacks": resolver.host_fallbacks - fall0,
@@ -271,7 +302,8 @@ def bench_pipeline(quick: bool):
 # 2. e2e: contended rw-register analog, host vs device resolver
 # ---------------------------------------------------------------------------
 
-def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool):
+def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool,
+                  overlap: bool = True):
     from accord_tpu.sim.burn import run_burn
     from accord_tpu.sim.cluster import ClusterConfig
 
@@ -287,7 +319,7 @@ def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool):
         def factory():
             r = BatchDepsResolver(num_buckets=E2E_BUCKETS,
                                   initial_cap=E2E_ARENA_CAP,
-                                  max_dispatch=256)
+                                  max_dispatch=256, overlap_host=overlap)
             resolvers.append(r)
             return r
 
@@ -350,16 +382,36 @@ def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool):
             raise AssertionError(
                 f"granular uploads not below full-row baseline: "
                 f"{ub} >= {ube}")
+        # staged tick pipeline engaged (overlap legs): the launches must
+        # come off the encode-ahead lists, not the serial fallback
+        staged = sum(r.staged_dispatches for r in resolvers)
+        if overlap and dispatches and staged == 0:
+            raise AssertionError(
+                "staged pipeline disengaged in the e2e burn "
+                "(overlap_host=True but no encode-ahead launches)")
+        if not overlap and staged:
+            raise AssertionError(
+                f"serial leg took {staged} staged launches")
+        phases = sum(r.preaccept_s + r.encode_s + r.dispatch_s + r.decode_s
+                     for r in resolvers)
+        hidden = sum(r.host_hidden_s for r in resolvers)
         by_field = {}
         for r in resolvers:
             for k, v in r.upload_bytes_by_field.items():
                 by_field[k] = by_field.get(k, 0) + v
         stats = {
+            "overlap_host": overlap,
             "dispatches": dispatches,
+            "staged_dispatches": staged,
             "ticks": ticks,
             "dispatches_per_tick": round(dispatches / max(ticks, 1), 3),
             "subjects": sum(r.subjects for r in resolvers),
+            "preaccept_s": round(sum(r.preaccept_s for r in resolvers), 2),
             "encode_s": round(sum(r.encode_s for r in resolvers), 2),
+            "dispatch_s": round(sum(r.dispatch_s for r in resolvers), 2),
+            "host_hidden_s": round(hidden, 2),
+            "host_hidden_pct": round(100.0 * hidden / phases, 1)
+            if phases else 0.0,
             "harvest_stall_s": round(sum(r.harvest_stall_s for r in resolvers), 2),
             "decode_s": round(sum(r.decode_s for r in resolvers), 2),
             "prefetched": sum(r.prefetched for r in resolvers),
@@ -388,19 +440,30 @@ def bench_e2e(quick: bool):
         attempts.append(bench_e2e_leg(9, ops, concurrency, True))
     dev_wall, dev_rep, dev_stats = min(attempts, key=lambda a: a[0])
     dev_stats["attempt_walls_s"] = [round(a[0], 1) for a in attempts]
+    # the serial-tick baseline (overlap_host=False): same workload, same
+    # device path, host phases NOT overlapped with the in-flight window
+    ser_wall, ser_rep, ser_stats = bench_e2e_leg(9, ops, concurrency, True,
+                                                 overlap=False)
     host_rate = host_rep.acked / host_wall
     dev_rate = dev_rep.acked / dev_wall
+    ser_rate = ser_rep.acked / ser_wall
     return {
         "ops": ops,
         "concurrency": concurrency,
         "txns_per_sec": {"host": round(host_rate, 1),
                          "device": round(dev_rate, 1),
-                         "ratio": round(dev_rate / host_rate, 3)},
-        "wall_s": {"host": round(host_wall, 1), "device": round(dev_wall, 1)},
-        "acked": {"host": host_rep.acked, "device": dev_rep.acked},
-        "failed": {"host": host_rep.failed, "device": dev_rep.failed},
+                         "device_serial_tick": round(ser_rate, 1),
+                         "ratio": round(dev_rate / host_rate, 3),
+                         "overlap_vs_serial": round(dev_rate / ser_rate, 3)},
+        "wall_s": {"host": round(host_wall, 1), "device": round(dev_wall, 1),
+                   "device_serial_tick": round(ser_wall, 1)},
+        "acked": {"host": host_rep.acked, "device": dev_rep.acked,
+                  "device_serial_tick": ser_rep.acked},
+        "failed": {"host": host_rep.failed, "device": dev_rep.failed,
+                   "device_serial_tick": ser_rep.failed},
         "host": host_stats,
         "device": dev_stats,
+        "device_serial_tick": ser_stats,
     }
 
 
@@ -468,6 +531,159 @@ def bench_range_mix(quick: bool):
         "stale_harvests": sum(r.stale_harvests for r in res_a),
         "prefetched": sum(r.prefetched for r in res_a),
         "upload_bytes": sum(r.upload_bytes for r in res_a),
+    }
+
+
+# ---------------------------------------------------------------------------
+# 2c. pad_store_tiers: fixed fused jit tier across participating-store counts
+# ---------------------------------------------------------------------------
+
+def bench_pad_tiers(quick: bool):
+    """Opt-in fused-dispatch padding on a 3-store node whose ticks touch a
+    VARYING number of stores. Unpadded, each participating-store count mints
+    its own fused jit tier; with pad_store_tiers=3 every fused call tops up
+    to the one pre-warmed 3-block tier with empty arena blocks, so the fused
+    compile counts must not move. Every answer is differentially checked."""
+    from accord_tpu.local.cfk import CfkStatus
+    from accord_tpu.ops.kernels import jit_cache_sizes
+    from accord_tpu.ops.resolver import BatchDepsResolver, warmup
+    from accord_tpu.primitives.keyspace import Keys
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+    from accord_tpu.sim.cluster import Cluster, ClusterConfig
+    from accord_tpu.utils.rng import RandomSource
+
+    buckets, cap = 128, 256
+    fused_kerns = ("fused_deps_resolve", "fused_range_deps_resolve")
+    # warm ONLY store tiers (1, 3): the padded leg needs nothing else; the
+    # unpadded leg's 2-store fused calls are deliberately left cold so its
+    # recompiles are visible
+    warmup(num_buckets=buckets, cap=cap, batch_tiers=(8,),
+           scatter_tiers=(8, 64), nnz_tiers=(32,), store_tiers=(1, 3))
+
+    def leg(pad):
+        cluster = Cluster(7, ClusterConfig(num_nodes=1, rf=1, num_shards=1,
+                                           stores_per_node=3, progress=False))
+        node = cluster.nodes[1]
+        stores = node.command_stores.stores
+        resolver = BatchDepsResolver(num_buckets=buckets, initial_cap=cap,
+                                     pad_store_tiers=pad)
+        for s in stores:
+            s.deps_resolver = resolver
+            s.batch_window_ms = 0.5
+        node.device_latency_ms = 5.0
+        rng = RandomSource(13)
+        lows = [min(int(r.start) for r in s.ranges) for s in stores]
+        for s, lo in zip(stores, lows):
+            for _ in range(24):
+                ts = node.unique_now()
+                tid = TxnId.create(ts.epoch, ts.hlc, ts.node, TxnKind.WRITE,
+                                   Domain.KEY)
+                keys = Keys(sorted({lo + rng.next_int(64) for _ in range(2)}))
+                s.register(tid, keys, CfkStatus.WITNESSED, ts)
+        cache0 = jit_cache_sizes()
+        checked = 0
+        # waves alternating 2-of-3 and 3-of-3 participating stores: the
+        # store-count axis the padding collapses
+        for wave, wave_stores in enumerate(
+                [stores[:2], stores, stores[1:], stores] * 2):
+            subs, outs = [], []
+            for s, lo in zip(wave_stores,
+                             [lows[stores.index(x)] for x in wave_stores]):
+                ts = node.unique_now()
+                tid = TxnId.create(ts.epoch, ts.hlc, ts.node, TxnKind.WRITE,
+                                   Domain.KEY)
+                keys = s.owned(Keys(sorted(
+                    {lo + rng.next_int(64) for _ in range(2)})))
+                subs.append((s, tid, keys, ts))
+                outs.append(resolver.enqueue_deps(s, tid, keys, ts))
+            cluster.queue.drain(max_events=100_000)
+            for (s, tid, keys, before), out in zip(subs, outs):
+                assert out.done
+                if out.value() != s.host_calculate_deps(tid, keys, before):
+                    raise AssertionError(
+                        f"pad leg (pad={pad}) diverges from host on {tid}")
+                checked += 1
+        cache1 = jit_cache_sizes()
+        recompiles = sum(cache1[k] - cache0[k] for k in fused_kerns)
+        return {"fused_recompiles": recompiles,
+                "padded_dispatches": resolver.padded_dispatches,
+                "dispatches": resolver.dispatches,
+                "host_fallbacks": resolver.host_fallbacks,
+                "differential_checked": checked}
+
+    padded = leg(3)
+    if padded["fused_recompiles"] != 0:
+        raise AssertionError(
+            f"padded leg minted {padded['fused_recompiles']} fused jit "
+            "tiers (pad_store_tiers should pin one compiled shape)")
+    if padded["padded_dispatches"] == 0:
+        raise AssertionError("padding never engaged (no 2-of-3-store ticks?)")
+    unpadded = leg(None)
+    if unpadded["fused_recompiles"] == 0:
+        raise AssertionError(
+            "unpadded leg minted no fused tiers -- the padded leg's "
+            "zero-recompile assertion is vacuous")
+    return {"padded": padded, "unpadded": unpadded}
+
+
+# ---------------------------------------------------------------------------
+# 2d. exec plane: field-granular wait-graph deltas
+# ---------------------------------------------------------------------------
+
+def bench_exec_plane(quick: bool):
+    """Burn with the device execution scheduler load-bearing: the wait-graph
+    arena's status-bump traffic (executeAt, applied/pending flips) must ship
+    single lanes through the shared flush_lane helper, strictly undercutting
+    the retired whole-row scheme (upload_bytes_full_equiv)."""
+    from accord_tpu.ops.exec_plane import ExecPlane
+    from accord_tpu.sim.burn import run_burn
+    from accord_tpu.sim.cluster import ClusterConfig
+
+    ops = 100 if quick else 400
+    planes = []
+    orig_init = ExecPlane.__init__
+
+    def spy(self, *a, **kw):
+        orig_init(self, *a, **kw)
+        planes.append(self)
+
+    ExecPlane.__init__ = spy
+    try:
+        t0 = time.perf_counter()
+        rep = run_burn(31, ops=ops, key_count=HOT_KEYS, zipf_theta=0.99,
+                       config=ClusterConfig(exec_plane=True, durability=True,
+                                            durability_interval_ms=1000.0))
+        wall = time.perf_counter() - t0
+    finally:
+        ExecPlane.__init__ = orig_init
+    if rep.lost:
+        raise AssertionError(f"exec-plane burn lost {rep.lost} acked txns")
+    ub = sum(p.upload_bytes for p in planes)
+    ube = sum(p.upload_bytes_full_equiv for p in planes)
+    by_field = {}
+    for p in planes:
+        for k, v in p.upload_bytes_by_field.items():
+            by_field[k] = by_field.get(k, 0) + v
+    if by_field.get("ts", 0) + by_field.get("flags", 0) == 0:
+        raise AssertionError(
+            "exec plane shipped no granular lane deltas (every update "
+            "took the full-row path)")
+    if not ub < ube:
+        raise AssertionError(
+            f"exec-plane granular uploads not below full-row baseline: "
+            f"{ub} >= {ube}")
+    return {
+        "ops": ops,
+        "acked": rep.acked,
+        "failed": rep.failed,
+        "wall_s": round(wall, 1),
+        "planes": len(planes),
+        "releases": sum(p.releases for p in planes),
+        "dispatches": sum(p.dispatches for p in planes),
+        "upload_bytes": ub,
+        "upload_bytes_by_field": by_field,
+        "upload_bytes_full_equiv": ube,
+        "granular_saving_pct": round(100.0 * (1 - ub / ube), 1),
     }
 
 
@@ -588,9 +804,11 @@ def main(argv=None) -> int:
         # fused cross-store tiers must be pre-compiled for its
         # zero-recompile assertion (single-group dispatches reuse the
         # plain kernels, warmed by store tier 1)
+        # exec_caps=(1024,): the exec-plane leg's wait-graph arenas start at
+        # 1024 rows; warm their per-field lane-delta scatters too
         warmup(num_buckets=E2E_BUCKETS, cap=E2E_ARENA_CAP,
                batch_tiers=(8, 64, 128, 256), scatter_tiers=(8, 64),
-               store_tiers=(1, 2))
+               store_tiers=(1, 2), exec_caps=(1024,))
         # the large replay's admission windows dispatch anywhere between 129
         # and PIPE_BATCH subjects (~4 keys each), so every intermediate
         # subject tier and the 4096-entry CSR tier must be pre-compiled for
@@ -607,6 +825,8 @@ def main(argv=None) -> int:
         maelstrom = bench_maelstrom(args.quick)
         e2e = bench_e2e(args.quick)
         range_mix = bench_range_mix(args.quick)
+        pad_tiers = bench_pad_tiers(args.quick)
+        exec_plane = bench_exec_plane(args.quick)
 
         print(json.dumps({
             "metric": "preaccept_deps_block_us_at_10k_inflight",
@@ -621,6 +841,8 @@ def main(argv=None) -> int:
                 "maelstrom": maelstrom,
                 "e2e_contended": e2e,
                 "range_mix": range_mix,
+                "pad_store_tiers": pad_tiers,
+                "exec_plane": exec_plane,
             },
         }))
         return 0

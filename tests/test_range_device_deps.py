@@ -197,6 +197,72 @@ def test_compaction_with_range_calls_in_flight():
     assert nonempty > 0, "differential vacuous"
 
 
+def test_covered_bucket_contraction_vs_hull():
+    """The covered-bucket contraction (which retired the [kmin, kmax]
+    modular hull) must mark EXACTLY the buckets some interval key hashes
+    into -- randomized CSR lists with padding rows and >=K-wide intervals
+    -- and on sparse rows it must be strictly tighter than the hull span
+    the old encoding would have marked."""
+    import jax.numpy as jnp
+    from accord_tpu.ops.kernels import covered_buckets
+
+    K = 128
+    rng = np.random.default_rng(97)
+    partial_rows = 0
+    for _ in range(10):
+        b = 1 + int(rng.integers(0, 5))
+        iv_of, iv_s, iv_e = [], [], []
+        for subj in range(b):
+            for _ in range(1 + int(rng.integers(0, 3))):
+                s = int(rng.integers(0, 1 << 16))
+                w = int(rng.integers(1, 2 * K + 8)) if rng.integers(0, 2) \
+                    else int(rng.integers(1, 8))
+                iv_of.append(subj)
+                iv_s.append(s)
+                iv_e.append(s + w)
+        # CSR padding rows (iv_of == b): one degenerate, one nonempty --
+        # both must be dropped, not smeared into row b-1 or wrapped
+        iv_of += [b, b]
+        iv_s += [0, 0]
+        iv_e += [0, 5]
+        got = np.asarray(covered_buckets(
+            jnp.asarray(iv_of, jnp.int32), jnp.asarray(iv_s, jnp.int32),
+            jnp.asarray(iv_e, jnp.int32), b, K, 0, K)
+            .astype(jnp.float32)) > 0.5
+        truth = np.zeros((b, K), bool)
+        for o, s, e in zip(iv_of, iv_s, iv_e):
+            if o >= b:
+                continue
+            if e - s >= K:
+                truth[o, :] = True
+            else:
+                truth[o, np.arange(s, e) % K] = True
+        assert (got == truth).all(), "contraction != hashed-bucket truth"
+        partial_rows += int(((truth.sum(axis=1) > 0)
+                             & (truth.sum(axis=1) < K)).sum())
+    assert partial_rows > 0, "differential vacuous: every row was all-wide"
+
+    # the case the hull could never win: two narrow intervals far apart in
+    # ONE row. The retired hull marked every bucket between them; the
+    # contraction marks exactly the four hashed buckets.
+    got = np.asarray(covered_buckets(
+        jnp.asarray([0, 0], jnp.int32), jnp.asarray([10, 2000], jnp.int32),
+        jnp.asarray([12, 2002], jnp.int32), 1, K, 0, K)
+        .astype(jnp.float32))[0] > 0.5
+    marked = np.nonzero(got)[0]
+    assert set(marked.tolist()) == {10, 11, 2000 % K, 2001 % K}
+    hull_span = int(marked.max() - marked.min() + 1)
+    assert len(marked) < hull_span, "contraction no tighter than the hull"
+
+    # modular straddle: an interval crossing a multiple of K wraps its
+    # covered buckets around the ring exactly
+    got = np.asarray(covered_buckets(
+        jnp.asarray([0], jnp.int32), jnp.asarray([K * 5 - 2], jnp.int32),
+        jnp.asarray([K * 5 + 2], jnp.int32), 1, K, 0, K)
+        .astype(jnp.float32))[0] > 0.5
+    assert set(np.nonzero(got)[0].tolist()) == {K - 2, K - 1, 0, 1}
+
+
 def test_sharded_resolver_mixed_differential():
     """The mesh-sharded twin answers the same mixed key/range differential
     (rows over 'data'; the range kernel shards both arenas' rows)."""
