@@ -151,6 +151,23 @@ def encode_interval(r) -> Optional[Tuple[int, int]]:
     return s, e
 
 
+def encode_key_point_intervals(keys) -> Optional[List[Tuple[int, int, int]]]:
+    """A KEY subject's owned keys as (key, start, end) point intervals
+    [k, k+1), keeping the key alongside its entry so the finalized-CSR
+    range path can attribute each device hit segment back to its real key
+    (entries are 1:1 with keys; no merging). The interval pairs are exactly
+    what encode_seekable_intervals emits for Keys, so feeding these to the
+    candidate range kernel is bit-identical. None when any key is
+    unencodable (the caller answers that subject's range deps host-side)."""
+    out: List[Tuple[int, int, int]] = []
+    for k in keys:
+        s = _encode_endpoint(k)
+        if s is None:
+            return None
+        out.append((k, s, s + 1))
+    return out
+
+
 def encode_seekable_intervals(seekables) -> Optional[List[Tuple[int, int]]]:
     """A subject's owned keys/ranges as interval pairs for the range kernel:
     keys become point intervals [k, k+1). None when any piece is

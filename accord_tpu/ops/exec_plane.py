@@ -50,6 +50,10 @@ class ExecPlane:
         self.count = 0
         self.tick_ms = tick_ms
         self.device_latency_ms = device_latency_ms
+        # per-node fused dispatch (ExecCoordinator.register sets this):
+        # ticks route to the coordinator, which answers every store's
+        # frontier with ONE device call per node tick
+        self.coordinator: Optional["ExecCoordinator"] = None
         self.row_of: Dict[TxnId, int] = {}
         self.txn_ids: List[TxnId] = []
         self.encoder: Optional[TimestampEncoder] = None
@@ -331,19 +335,26 @@ class ExecPlane:
 
     # -- the tick/harvest pipeline -------------------------------------------
     def _schedule_tick(self) -> None:
+        if self.coordinator is not None:
+            self.coordinator.schedule()
+            return
         if self._ticking:
             return
         self._ticking = True
         self.store.node.scheduler.once(self.tick_ms, self._tick)
 
+    def _needs_dispatch(self) -> bool:
+        """The tick's launch gate: something pending AND either dirty state
+        to sync or no device copy yet (an unchanged arena's frontier was
+        already harvested; the next on_* hook re-arms the tick)."""
+        if not self.pending.any():
+            return False
+        return bool(self._dirty_full or self._dirty_ts or self._dirty_flags) \
+            or self._device is None
+
     def _tick(self) -> None:
         self._ticking = False
-        if not self.pending.any():
-            return
-        if not (self._dirty_full or self._dirty_ts or self._dirty_flags) \
-                and self._device is not None:
-            # unchanged arena => identical frontier, already harvested; the
-            # next on_* hook re-arms the tick
+        if not self._needs_dispatch():
             return
         frontier = self._dispatch()
         self._inflight.append([frontier, None, self._gen])
@@ -385,9 +396,21 @@ class ExecPlane:
         return m * (4 + self.cap // 8 + 12 + 3)
 
     def _dispatch(self):
+        """Solo (uncoordinated) launch: sync dirty rows, fire the plain
+        frontier kernel, enqueue its async readback."""
+        from accord_tpu.ops.kernels import execution_frontier
+        out = execution_frontier(*self._sync_device())
+        out.copy_to_host_async()
+        self.dispatches += 1
+        return out
+
+    def _sync_device(self):
+        """Flush the dirty sets into the device arena and return its lane
+        tuple (adj, exec_ts, applied, pending, awaits_all) -- the shared
+        front half of the solo dispatch and the coordinator's fused one."""
         import jax.numpy as jnp
         from accord_tpu.ops.deltas import flush_lane, lane_row_tier
-        from accord_tpu.ops.kernels import exec_scatter, execution_frontier
+        from accord_tpu.ops.kernels import exec_scatter
         if self._device is None:
             # the device adjacency lives UNPACKED (bool[cap, cap]); build it
             # by scattering every populated row's PACKED form -- the upload
@@ -451,14 +474,10 @@ class ExecPlane:
             d[3] = flush_lane(d[3], flags, self.pending, acct("flags"))
             self._dirty_flags.clear()
             self._device = tuple(d)
-        out = execution_frontier(*self._device)
-        out.copy_to_host_async()
-        self.dispatches += 1
-        return out
+        return self._device
 
     def _harvest(self) -> None:
         import time as _time
-        from accord_tpu.local import commands as _commands
         if not self._inflight:
             return  # defensive: every dispatch schedules exactly one harvest
         frontier, packed, gen = self._inflight.popleft()
@@ -468,6 +487,13 @@ class ExecPlane:
             self.harvest_stall_s += _time.perf_counter() - t0
         else:
             self.prefetched += 1
+        self._apply_frontier(packed, gen)
+
+    def _apply_frontier(self, packed: np.ndarray, gen: int) -> None:
+        """Release every frontier row against current host state (the back
+        half of the harvest, shared with the coordinator, which hands each
+        plane its word span of the fused readback)."""
+        from accord_tpu.local import commands as _commands
         if gen != self._gen:
             # compaction remapped rows while this frontier was in flight;
             # its indices address the old arena -- drop it (the rebuild
@@ -495,3 +521,102 @@ class ExecPlane:
             _commands.maybe_execute(store, cmd)
         if self.pending.any():
             self._schedule_tick()
+
+
+class ExecCoordinator:
+    """Per-NODE fusion of the exec planes' frontier calls, mirroring the
+    resolver's cross-store fused dispatch: each node tick collects every
+    registered plane with work, syncs their dirty rows, and answers all of
+    them with ONE device call -- the plain kernel for a single participant
+    (byte-identical to the solo path), `fused_execution_frontier` with
+    per-store word spans otherwise. Cuts per-tick launch count on
+    many-store nodes from stores-with-work to one."""
+
+    def __init__(self, node, tick_ms: float = 2.0,
+                 device_latency_ms: float = 4.0):
+        self.node = node
+        self.tick_ms = tick_ms
+        self.device_latency_ms = device_latency_ms
+        self.planes: List[ExecPlane] = []
+        self._ticking = False
+        # [fused frontier, host copy or None, [(plane, (lo, hi), gen)]]
+        self._inflight: deque = deque()
+        self._poll_armed = False
+        self.dispatches = 0
+        self.fused_dispatches = 0
+        self.harvest_stall_s = 0.0
+        self.prefetched = 0
+
+    def register(self, plane: ExecPlane) -> None:
+        plane.coordinator = self
+        self.planes.append(plane)
+
+    def schedule(self) -> None:
+        if self._ticking:
+            return
+        self._ticking = True
+        self.node.scheduler.once(self.tick_ms, self._tick)
+
+    def _tick(self) -> None:
+        from accord_tpu.ops.kernels import (execution_frontier,
+                                            fused_execution_frontier)
+        self._ticking = False
+        parts = [p for p in self.planes if p._needs_dispatch()]
+        if not parts:
+            return
+        devs = [p._sync_device() for p in parts]
+        if len(parts) == 1:
+            out = execution_frontier(*devs[0])
+            spans = [(0, parts[0].cap // 32)]
+        else:
+            out = fused_execution_frontier(tuple(devs))
+            spans, off = [], 0
+            for p in parts:
+                spans.append((off, off + p.cap // 32))
+                off += p.cap // 32
+            self.fused_dispatches += 1
+        out.copy_to_host_async()
+        self.dispatches += 1
+        for p in parts:
+            p.dispatches += 1
+        self._inflight.append(
+            [out, None, [(p, s, p._gen) for p, s in zip(parts, spans)]])
+        self.node.scheduler.once(self.device_latency_ms, self._harvest)
+        self._ensure_poll()
+
+    def _ensure_poll(self) -> None:
+        scheduler = self.node.scheduler
+        poll = getattr(scheduler, "poll", None)
+        interval = getattr(self.node, "device_poll_ms", None)
+        if poll is None or interval is None or self._poll_armed:
+            return
+        self._poll_armed = True
+        q = self._inflight
+
+        def prefetch() -> bool:
+            for entry in q:
+                if entry[1] is not None:
+                    continue
+                if not entry[0].is_ready():
+                    break  # single device stream: later calls finish later
+                entry[1] = np.asarray(entry[0])
+            if q:
+                return True
+            self._poll_armed = False
+            return False
+
+        poll(interval, prefetch)
+
+    def _harvest(self) -> None:
+        import time as _time
+        if not self._inflight:
+            return  # defensive: every dispatch schedules exactly one harvest
+        frontier, packed, entries = self._inflight.popleft()
+        if packed is None:
+            t0 = _time.perf_counter()
+            packed = np.asarray(frontier)
+            self.harvest_stall_s += _time.perf_counter() - t0
+        else:
+            self.prefetched += 1
+        for plane, (lo, hi), gen in entries:
+            plane._apply_frontier(packed[lo:hi], gen)

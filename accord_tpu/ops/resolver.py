@@ -65,6 +65,7 @@ import numpy as np
 from accord_tpu.local.cfk import CfkStatus
 from accord_tpu.ops.encoding import (TimestampEncoder, WITNESS_TABLE,
                                      encode_interval,
+                                     encode_key_point_intervals,
                                      encode_seekable_intervals)
 from accord_tpu.primitives.deps import Deps, KeyDepsBuilder, RangeDepsBuilder
 from accord_tpu.primitives.keyspace import Keys, Range, Ranges, Seekables
@@ -120,7 +121,8 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
            batch_tiers=(8, 64, 128), scatter_tiers=(8, 64),
            nnz_tiers=None, scatter_nnz_tiers=None,
            range_cap: int = 64, store_tiers=(1, 2),
-           exec_caps=()) -> None:
+           exec_caps=(), out_tiers=(), range_out_tiers=None,
+           kid_cap: int = 4096) -> None:
     """Pre-compile the jit shape tiers the async pipeline uses (first
     compilation costs seconds on a tunnelled TPU; production would do the
     same at process start). The jit cache is process-global, so one call
@@ -138,7 +140,13 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
     (arena_scatter_keys and the single-lane scatter_rows used by ts-only /
     valid-only updates). `exec_caps` additionally warms the exec_plane's
     per-field lane deltas (exec-ts / applied / pending rows) for each
-    execution-arena capacity in use."""
+    execution-arena capacity in use. `out_tiers` (opt-in: it multiplies the
+    cross product) warms the finalized-CSR harvest kernels -- finalize_csr
+    across (batch, slot-nnz, store, out_cap) tiers at (`kid_cap`, cap/32)
+    kid-table shape, range_finalize_csr across (nnz, batch, out_cap), and
+    the kid-table word scatter per scatter-nnz tier. `range_out_tiers`
+    overrides the range kernel's out ladder (pass () for key-only
+    workloads, where compiling the range compaction would be waste)."""
     import jax.numpy as jnp
     from accord_tpu.ops.kernels import (NNZ_TIERS, SCATTER_NNZ_TIERS,
                                         arena_scatter, arena_scatter_keys,
@@ -217,6 +225,37 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
                 out = fused_range_deps_resolve(of, zz, zz, sst, sb, sknd,
                                                srng, slots, rarenas, slots,
                                                karenas, table)
+    if out_tiers:
+        from accord_tpu.ops.kernels import (finalize_csr, kid_word_scatter,
+                                            range_finalize_csr)
+        w = cap // 32
+        kid_rows = jnp.zeros((kid_cap, w), jnp.uint32)
+        for z in scatter_nnz_tiers:
+            out = kid_word_scatter(kid_rows, jnp.full(z, kid_cap, jnp.int32),
+                                   jnp.zeros(z, jnp.int32),
+                                   jnp.zeros(z, jnp.uint32))
+        zero_off = jnp.asarray(0, jnp.int32)
+        for b in batch_tiers:
+            sb = jnp.zeros((b, 3), jnp.int32)
+            sknd = jnp.zeros(b, jnp.int32)
+            srow = jnp.full(b, -1, jnp.int32)
+            for s in store_tiers:
+                packed = jnp.zeros((b, max(s, 1) * w), jnp.uint32)
+                for z in nnz_tiers:
+                    subj = jnp.full(z, b, jnp.int32)
+                    kidx = jnp.full(z, kid_cap, jnp.int32)
+                    for oc in out_tiers:
+                        out = finalize_csr(packed, zero_off, kid_rows,
+                                           subj, kidx, srow, ts, out_cap=oc)
+            for z in nnz_tiers:
+                of = jnp.full(z, b, jnp.int32)
+                zz = jnp.zeros(z, jnp.int32)
+                ok = jnp.zeros(z, bool)
+                for oc in (out_tiers if range_out_tiers is None
+                           else range_out_tiers):
+                    out = range_finalize_csr(of, zz, zz, ok, sb, sknd,
+                                             rs, re_, rts, rkd, rvl,
+                                             table, out_cap=oc)
     if out is not None:
         import jax
         jax.block_until_ready(out)
@@ -258,7 +297,8 @@ class _StoreArena:
 
     def __init__(self, num_buckets: int, initial_cap: int = 4096,
                  range_cap: int = 64,
-                 shared_encoder: Optional[_NodeEncoder] = None):
+                 shared_encoder: Optional[_NodeEncoder] = None,
+                 kid_cap: int = 4096):
         self.num_buckets = num_buckets
         self.cap = initial_cap
         self.count = 0
@@ -284,6 +324,29 @@ class _StoreArena:
         # that key's dependency rows with pure numpy -- the vectorized CSR
         # decode that makes the device path cheaper than the host scan
         self.key_rows: Dict[object, np.ndarray] = {}
+        # DEVICE mirror of key_rows for finalize_csr (the on-device exact
+        # filter): each key gets a dense id at first sighting and a
+        # u32[kid_cap, cap/32] row in _kid_dev. Maintained by WORD-granular
+        # deltas -- any bit set/clear marks its (kid, word) coordinate dirty,
+        # and kid_sync ships the deduped words' full current values (no RMW
+        # hazard). Ids are never reused; the mirror rebuilds wholesale on
+        # compaction / growth (shape change).
+        self.kid_cap = kid_cap
+        self.kid_of: Dict[object, int] = {}
+        self._key_of_kid: Dict[int, object] = {}
+        self._kid_dev = None
+        self._dirty_kid_words: set = set()
+        # exact per-key live-row popcount: sizing finalize_csr's out_cap from
+        # the sum over a dispatch's (subject, key) slots gives a bound the
+        # compaction output can never overflow (belt-and-braces checked)
+        self.key_pop: Dict[object, int] = {}
+        # bumped whenever a key's row-mask bits change on rows the device
+        # may already have answered for: key-set widening of an EXISTING row
+        # and prune/truncate clears. An in-flight finalized result whose
+        # kseq no longer matches falls back to the legacy decode (new-row
+        # bit sets don't bump -- rows born after the encode have no bits in
+        # either path's snapshot)
+        self.kseq = 0
         # rows of INVALIDATED txns: the device excludes them via the valid
         # lane (the `valid` lane is overloaded -- also false for emptied rows)
         self.invalidated: set = set()
@@ -317,7 +380,7 @@ class _StoreArena:
         # baseline the field-granular deltas are measured against)
         self.upload_bytes = 0
         self.upload_bytes_by_field = {"full": 0, "keys": 0, "ts": 0,
-                                      "valid": 0}
+                                      "valid": 0, "kids": 0}
         self.upload_bytes_full_equiv = 0
         # the store's ACTIVE RANGE TXNS, mirrored as interval rows; shares
         # the node's timestamp encoder so the kernels' before-compares are
@@ -356,6 +419,9 @@ class _StoreArena:
             self.key_rows[k] = np.pad(self.key_rows[k],
                                       (0, (new_cap - self.cap) // 32))
         self.cap = new_cap
+        # word width changed: the kid mirror rebuilds at the new shape
+        self._kid_dev = None
+        self._dirty_kid_words.clear()
 
     def compact(self) -> bool:
         """Rebuild the arena keeping only rows that still carry keys: pruned
@@ -385,6 +451,9 @@ class _StoreArena:
         self.exec_max = []
         self.row_of = {}
         self.key_rows = {}
+        self.key_pop = {}
+        self._kid_dev = None
+        self._dirty_kid_words = set()
         self.row_mods = []
         self.invalidated = set()
         self.ts[:] = 0
@@ -505,6 +574,10 @@ class _StoreArena:
             self.key_sets[row] = self.key_sets[row] | frozenset(key_set)
             self._set_row_keys(row)
             self._mark_dirty(row, self._dirty_keys)
+            # an EXISTING row gained key bits: in-flight finalized results
+            # snapshotted the old mask, so their exact filter may miss this
+            # row where the legacy re-decode would see it
+            self.kseq += 1
         # MaxConflicts is monotone in the reference: even an invalidated
         # txn's registration bumps the conflict floor
         prev = self.exec_max[row]
@@ -531,12 +604,29 @@ class _StoreArena:
         kr = self.key_rows.get(key)
         if kr is None:
             kr = self.key_rows[key] = np.zeros(self.cap // 32, np.uint32)
-        kr[row >> 5] |= np.uint32(1 << (row & 31))
+            if key not in self.kid_of:
+                kid = len(self.kid_of)
+                self.kid_of[key] = kid
+                self._key_of_kid[kid] = key
+                if kid >= self.kid_cap:
+                    # dense id space overflowed the mirror: double and rebuild
+                    self.kid_cap *= 2
+                    self._kid_dev = None
+                    self._dirty_kid_words.clear()
+        bit = np.uint32(1 << (row & 31))
+        if not kr[row >> 5] & bit:
+            kr[row >> 5] |= bit
+            self.key_pop[key] = self.key_pop.get(key, 0) + 1
+            self._dirty_kid_words.add((self.kid_of[key], row >> 5))
 
     def _clear_key_row_bit(self, key, row: int) -> None:
         kr = self.key_rows.get(key)
         if kr is not None:
-            kr[row >> 5] &= np.uint32(~(1 << (row & 31)) & 0xFFFFFFFF)
+            bit = np.uint32(1 << (row & 31))
+            if kr[row >> 5] & bit:
+                kr[row >> 5] &= ~bit
+                self.key_pop[key] = self.key_pop.get(key, 1) - 1
+                self._dirty_kid_words.add((self.kid_of[key], row >> 5))
 
     def decode_packed(self, txn_id: TxnId, owned_keys, prow: np.ndarray,
                       store=None, before=None, cover_seq=0):
@@ -637,6 +727,9 @@ class _StoreArena:
             self._clear_key_row_bit(k, row)
         self.key_sets[row] = remaining
         self.had_truncation = True
+        # bits cleared on rows in-flight finalized results may have kept:
+        # their kseq no longer matches, routing them to the legacy decode
+        self.kseq += 1
         self._set_row_keys(row)
         self._mark_dirty(row, self._dirty_keys)
         if not remaining:
@@ -783,6 +876,51 @@ class _StoreArena:
         d[lane] = flush_lane(d[lane], rows, src, account)
         self._device = tuple(d)
 
+    def kid_arrays(self):
+        """Device mirror of key_rows for finalize_csr: u32[kid_cap, cap/32],
+        row kid = the packed row-mask of the key with that dense id. Synced
+        by word-granular deltas -- each dirty (kid, word) coordinate ships
+        the word's FULL current value (host-deduped set, so no read-modify-
+        write hazard), chunked through the shared scatter_nnz tiers."""
+        import jax.numpy as jnp
+        from accord_tpu.ops.kernels import kid_word_scatter, scatter_nnz_tier
+        w = self.cap // 32
+        if self._kid_dev is None or self._kid_dev.shape != (self.kid_cap, w):
+            self._kid_dev = jnp.zeros((self.kid_cap, w), jnp.uint32)
+            # wholesale rebuild: every nonzero word of every key's mask
+            self._dirty_kid_words = {
+                (self.kid_of[k], int(wi))
+                for k, kr in self.key_rows.items()
+                for wi in np.nonzero(kr)[0]
+            }
+        if self._dirty_kid_words:
+            coords = sorted(self._dirty_kid_words)
+            self._dirty_kid_words = set()
+            for lo in range(0, len(coords), 512):
+                chunk = coords[lo:lo + 512]
+                z = scatter_nnz_tier(len(chunk))
+                # padding coordinates use kid == kid_cap: out of bounds in
+                # the scatter's drop mode
+                kid_idx = np.full(z, self.kid_cap, dtype=np.int32)
+                word_idx = np.zeros(z, dtype=np.int32)
+                words = np.zeros(z, dtype=np.uint32)
+                for j, (kid, wi) in enumerate(chunk):
+                    kid_idx[j] = kid
+                    word_idx[j] = wi
+                    words[j] = self.key_rows[self._key_of_kid[kid]][wi]
+                nb = kid_idx.nbytes + word_idx.nbytes + words.nbytes
+                self.upload_bytes += nb
+                self.upload_bytes_by_field["kids"] += nb
+                # the kid table is a finalize-path structure both upload
+                # strategies would ship identically, so it lands in the
+                # full-equivalent baseline too (granular-vs-full deltas
+                # stay a statement about the row lanes)
+                self.upload_bytes_full_equiv += nb
+                self._kid_dev = kid_word_scatter(
+                    self._kid_dev, jnp.asarray(kid_idx),
+                    jnp.asarray(word_idx), jnp.asarray(words))
+        return self._kid_dev
+
 
 class _RangeArena:
     """Incremental device mirror of one STORE's active RANGE-TXN set: one
@@ -844,6 +982,13 @@ class _RangeArena:
         self.gen = 0
         self.retired_ids: Dict[int, np.ndarray] = {}
         self._gen_pins: Dict[int, int] = {}
+        # bumped whenever rows are FREED (drop / re-registration): a freed
+        # row can be REUSED for another txn before an in-flight finalized
+        # range result harvests, and the exact hits it computed at dispatch
+        # would then translate to the wrong txn id. On mismatch the harvest
+        # falls back to the legacy candidate decode, which re-filters
+        # against current host state (bit-identical by construction)
+        self.rseq = 0
 
     # -- host-side mutation ---------------------------------------------------
     def update(self, txn_id: TxnId, rngs: Ranges, status: CfkStatus) -> None:
@@ -886,7 +1031,10 @@ class _RangeArena:
             self._drop_rows(txn_id)
 
     def _drop_rows(self, txn_id: TxnId) -> None:
-        for r in self.rows_of.pop(txn_id, []):
+        rows = self.rows_of.pop(txn_id, [])
+        if rows:
+            self.rseq += 1
+        for r in rows:
             self.valid[r] = False
             self.ids_np[r] = None
             self._free.append(r)
@@ -909,6 +1057,8 @@ class _RangeArena:
         while len(self._free) + len(old) + (self.cap - self.count) \
                 < len(encoded):
             self._grow()
+        if old:
+            self.rseq += 1
         for r in old:
             self.valid[r] = False
             self.ids_np[r] = None
@@ -1103,7 +1253,9 @@ class _Group:
     readback back to each store's decode."""
 
     __slots__ = ("store", "arena", "idx", "items", "gen", "rgen",
-                 "pinned", "rpinned", "pk", "rp", "kp")
+                 "pinned", "rpinned", "pk", "rp", "kp",
+                 "kseq", "rseq", "fin_dev", "fin_np", "fin_slots",
+                 "rfin_dev", "rfin_np", "rents")
 
     def __init__(self, store, arena):
         self.store = store
@@ -1119,24 +1271,67 @@ class _Group:
         self.pk: Optional[Tuple[int, int]] = None
         self.rp: Optional[Tuple[int, int]] = None
         self.kp: Optional[Tuple[int, int]] = None
+        # finalize_on_device state: the mutation-sequence snapshots the
+        # harvest guards against, the deferred finalize kernels' device
+        # (indptr, dep_rows, dep_ts) triples + their host copies, and the
+        # host-side routing tables the materialization walks
+        self.kseq = arena.kseq
+        self.rseq = arena.ranges.rseq
+        self.fin_dev = None
+        self.fin_np = None
+        # (flat_key list, key_off) in legacy-decode slot order, or None
+        # when this group planned no finalized key call
+        self.fin_slots = None
+        self.rfin_dev = None
+        self.rfin_np = None
+        # [(global interval-CSR entry, local item index, key)], or None
+        self.rents = None
+
+
+def _dev_ready(dev) -> bool:
+    """is_ready over a device value that may be a tuple (the finalize
+    kernels return (indptr, dep_rows, dep_ts) triples)."""
+    if isinstance(dev, tuple):
+        return all(b.is_ready() for b in dev)
+    return dev.is_ready()
+
+
+def _dev_read(dev):
+    if isinstance(dev, tuple):
+        return tuple(np.asarray(b) for b in dev)
+    return np.asarray(dev)
+
+
+def _dev_copy_async(dev) -> None:
+    if isinstance(dev, tuple):
+        for b in dev:
+            b.copy_to_host_async()
+    else:
+        dev.copy_to_host_async()
 
 
 class _Call:
     """One in-flight kernel dispatch: up to three device result buffers
     (key-domain deps, range-arena candidates, key-arena candidates for range
-    subjects), the per-store groups whose spans slice them, and the
-    generation pins needed to decode after a compaction (held per group, so
-    one store compacting never disturbs a batchmate)."""
+    subjects) plus each group's finalized-CSR triples, the per-store groups
+    whose spans slice them, and the generation pins needed to decode after a
+    compaction (held per group, so one store compacting never disturbs a
+    batchmate). `want` flags which RAW candidate buffers the harvest reads
+    back: the finalized path leaves packed/rpacked device-resident (harvest
+    reads only the compacted CSR) unless a guard trips, in which case the
+    fallback fetches them lazily -- blocking, and counted as readback."""
 
     __slots__ = ("packed", "rpacked", "kpacked", "items", "groups",
-                 "np_packed", "np_rpacked", "np_kpacked")
+                 "np_packed", "np_rpacked", "np_kpacked", "want")
 
-    def __init__(self, packed, rpacked, kpacked, items, groups):
+    def __init__(self, packed, rpacked, kpacked, items, groups,
+                 want=(True, True, True)):
         self.packed = packed        # fused key-domain result (or None)
         self.rpacked = rpacked      # fused range-arena result
         self.kpacked = kpacked      # fused key-arena hull result
         self.items = items
         self.groups: List[_Group] = groups
+        self.want = want
         # host copies, filled by the poll prefetch once the device finishes
         # (or by a blocking read at harvest when it hasn't)
         self.np_packed: Optional[np.ndarray] = None
@@ -1144,8 +1339,21 @@ class _Call:
         self.np_kpacked: Optional[np.ndarray] = None
 
     def buffers(self):
-        return (("np_packed", self.packed), ("np_rpacked", self.rpacked),
-                ("np_kpacked", self.kpacked))
+        """(holder, host attr, device value) triples the async-copy / poll /
+        fetch machinery drains: the wanted raw candidate buffers plus every
+        group's finalized-CSR results."""
+        out = []
+        for (attr, buf), w in zip(
+                (("np_packed", self.packed), ("np_rpacked", self.rpacked),
+                 ("np_kpacked", self.kpacked)), self.want):
+            if w and buf is not None:
+                out.append((self, attr, buf))
+        for g in self.groups:
+            if g.fin_dev is not None:
+                out.append((g, "fin_np", g.fin_dev))
+            if g.rfin_dev is not None:
+                out.append((g, "rfin_np", g.rfin_dev))
+        return out
 
     @property
     def has_device(self) -> bool:
@@ -1155,9 +1363,9 @@ class _Call:
         """Blocking read of any result the poll didn't drain; True if it
         actually had to read (the harvest stall case)."""
         stalled = False
-        for attr, buf in self.buffers():
-            if buf is not None and getattr(self, attr) is None:
-                setattr(self, attr, np.asarray(buf))
+        for holder, attr, dev in self.buffers():
+            if getattr(holder, attr) is None:
+                setattr(holder, attr, _dev_read(dev))
                 stalled = True
         return stalled
 
@@ -1175,7 +1383,8 @@ class _Plan:
     but still flow through the pipeline so floors and fallbacks inject at
     harvest."""
 
-    __slots__ = ("items", "groups", "key_call", "range_call", "empty")
+    __slots__ = ("items", "groups", "key_call", "range_call", "empty",
+                 "fin_calls", "rfin_calls", "want")
 
     def __init__(self, items: List[_Item], groups: List[_Group],
                  empty: bool = False):
@@ -1184,6 +1393,13 @@ class _Plan:
         self.key_call = None        # () -> packed, or None
         self.range_call = None      # () -> (rpacked, kpacked), or None
         self.empty = empty
+        # finalize_on_device: deferred finalize kernel launches per group --
+        # the key call consumes the packed result, the range call closes
+        # over its group's interval-arena snapshot
+        self.fin_calls: List[tuple] = []    # [(group, packed -> triple)]
+        self.rfin_calls: List[tuple] = []   # [(group, () -> triple)]
+        # which raw candidate buffers the harvest should read back
+        self.want = (True, True, True)
 
 
 class BatchDepsResolver(DepsResolver):
@@ -1193,7 +1409,10 @@ class BatchDepsResolver(DepsResolver):
                  max_dispatch: Optional[int] = None,
                  fuse_cross_store: bool = True,
                  overlap_host: bool = True,
-                 pad_store_tiers: Optional[int] = None):
+                 pad_store_tiers: Optional[int] = None,
+                 finalize_on_device: bool = True,
+                 adaptive_window: bool = False,
+                 kid_cap: int = 4096):
         # the range kernel's covered-bucket contraction reduces intervals
         # modulo the bucket count with int32 arithmetic; that wrap is exact
         # only when num_buckets divides 2^32
@@ -1219,6 +1438,20 @@ class BatchDepsResolver(DepsResolver):
         # with cached empty arena blocks so many-store nodes compile ONE
         # jit tier instead of one per participating-store count
         self.pad_store_tiers = pad_store_tiers
+        # True (default): the deps kernels' bucket-level results run through
+        # finalize_csr / range_finalize_csr on device -- exact key filtering
+        # + segment compaction -- so harvest reads back one contiguous
+        # (indptr, dep_rows, dep_ts) CSR per store instead of the full bit
+        # matrices. False: the legacy unpackbits decode, the bit-identical
+        # differential baseline (also the automatic per-group fallback when
+        # a sequence guard trips mid-flight).
+        self.finalize_on_device = finalize_on_device
+        # opt-in: scale each node's staged dispatch window by drain
+        # pressure (empty drains shrink it, full drains widen it)
+        self.adaptive_window = adaptive_window
+        self._win_scale: Dict[int, float] = {}
+        # initial key-id capacity of each arena's device key-mask mirror
+        self.kid_cap = kid_cap
         import jax.numpy as jnp
         self.num_buckets = num_buckets
         self.initial_cap = initial_cap
@@ -1237,9 +1470,10 @@ class BatchDepsResolver(DepsResolver):
         self._staged: Dict[int, List[_Plan]] = {}
         # last batch window seen per node, for the self-armed launch tick
         self._windows: Dict[int, float] = {}
-        # cached empty arena blocks for pad_store_tiers
-        self._pad_key = None
-        self._pad_range = None
+        # cached empty arena blocks for pad_store_tiers, keyed by capacity
+        # (the pool grows alongside arenas that outgrow initial_cap)
+        self._pad_key: Dict[int, tuple] = {}
+        self._pad_range: Dict[int, tuple] = {}
         # bench counters
         self.dispatches = 0
         self.subjects = 0
@@ -1249,6 +1483,10 @@ class BatchDepsResolver(DepsResolver):
         self.dispatch_s = 0.0        # kernel launch + readback enqueue
         self.harvest_stall_s = 0.0   # blocking on the async transfer
         self.decode_s = 0.0          # host-side result materialization
+        self.readback_s = 0.0        # device->host transfer time (stalls +
+        #                              lazy fallback fetches; prefetched
+        #                              transfers cost ~0 here)
+        self.materialize_s = 0.0     # decode_s minus readback inside decode
         self.host_hidden_s = 0.0     # host phase time spent while >=1 call
         #                              was in flight (overlapped = hidden)
         self.staged_dispatches = 0   # launches that came off the staged list
@@ -1261,6 +1499,16 @@ class BatchDepsResolver(DepsResolver):
         # subjects demoted host-side for unencodable range endpoints (never
         # hit by integer key domains)
         self.range_fallbacks = 0
+        # finalized-CSR harvest accounting: groups materialized straight
+        # from the compacted device CSR vs groups that ran the legacy
+        # unpackbits decode (finalize off, or a guard tripped -- the latter
+        # also counted as finalize_fallbacks)
+        self.finalized_decodes = 0
+        self.legacy_decodes = 0
+        self.finalize_fallbacks = 0
+        # adaptive staged window: scale adjustments per direction
+        self.window_shrinks = 0
+        self.window_widens = 0
         # initial _RangeArena capacity (the sharded resolver widens it to
         # keep rcap % (32*data) == 0)
         self.range_cap = 64
@@ -1285,7 +1533,7 @@ class BatchDepsResolver(DepsResolver):
         """upload_bytes broken out per field group: `full` rows carry every
         lane; `keys`/`ts`/`valid` (and `range_full`/`range_valid`) are the
         field-granular deltas."""
-        agg = {"full": 0, "keys": 0, "ts": 0, "valid": 0,
+        agg = {"full": 0, "keys": 0, "ts": 0, "valid": 0, "kids": 0,
                "range_full": 0, "range_valid": 0}
         for a in self._arenas.values():
             for k, v in a.upload_bytes_by_field.items():
@@ -1310,7 +1558,8 @@ class BatchDepsResolver(DepsResolver):
             if enc is None:
                 enc = self._encoders[id(store.node)] = _NodeEncoder()
             arena = _StoreArena(self.num_buckets, self.initial_cap,
-                                self.range_cap, shared_encoder=enc)
+                                self.range_cap, shared_encoder=enc,
+                                kid_cap=self.kid_cap)
             self._arenas[id(store)] = arena
             # adopt anything registered before the resolver was attached
             for key, cfk in store.cfks.items():
@@ -1373,7 +1622,8 @@ class BatchDepsResolver(DepsResolver):
         if id(node) in self._ticking:
             return
         self._ticking.add(id(node))
-        node.scheduler.once(store.batch_window_ms, lambda: self._tick(node))
+        node.scheduler.once(self._window(node, store.batch_window_ms),
+                            lambda: self._tick(node))
 
     def _arm_tick(self, node) -> None:
         """Self-arm the next tick so staged plans launch even when no new
@@ -1381,8 +1631,32 @@ class BatchDepsResolver(DepsResolver):
         if id(node) in self._ticking:
             return
         self._ticking.add(id(node))
-        window = self._windows.get(id(node)) or 0.0
+        window = self._window(node, self._windows.get(id(node)) or 0.0)
         node.scheduler.once(window, lambda: self._tick(node))
+
+    def _window(self, node, base):
+        """The node's effective dispatch window: the store-configured base,
+        scaled by the adaptive controller when enabled."""
+        if not self.adaptive_window or not base:
+            return base
+        return base * self._win_scale.get(id(node), 1.0)
+
+    def _adapt(self, node, drained: int) -> None:
+        """Adaptive staged window: an empty drain means the window overshot
+        the arrival rate (halve the scale, floor 0.25x -- ticks fire sooner,
+        trimming queue latency); a drain filling at least one max dispatch
+        means it undershot (double, cap 4x -- bigger batches amortize the
+        launch/readback round trip under sustained load)."""
+        if not self.adaptive_window:
+            return
+        s = self._win_scale.get(id(node), 1.0)
+        if drained == 0:
+            if s > 0.25:
+                self._win_scale[id(node)] = max(0.25, s * 0.5)
+                self.window_shrinks += 1
+        elif drained >= self.max_dispatch and s < 4.0:
+            self._win_scale[id(node)] = min(4.0, s * 2.0)
+            self.window_widens += 1
 
     def _tick(self, node) -> None:
         """One node tick. Serial mode (overlap_host=False) runs preaccept ->
@@ -1398,6 +1672,7 @@ class BatchDepsResolver(DepsResolver):
         self._ticking.discard(id(node))
         if not self.overlap_host:
             items = self._drain_and_preaccept(node)
+            self._adapt(node, len(items))
             for sub in self._slices(items):
                 self._dispatch(node, sub)
             return
@@ -1410,6 +1685,7 @@ class BatchDepsResolver(DepsResolver):
         # upload, so batchmates still witness each other.
         t0 = _time.perf_counter()
         items = self._drain_and_preaccept(node)
+        self._adapt(node, len(items))
         plans = [self._stage(node, sub) for sub in self._slices(items)]
         if self._inflight.get(id(node)):
             self.host_hidden_s += _time.perf_counter() - t0
@@ -1465,11 +1741,6 @@ class BatchDepsResolver(DepsResolver):
                 for sub in by_store.values()
                 for lo in range(0, len(sub), self.max_dispatch)]
 
-    def _encode_and_run(self, groups: List[_Group], items: List[_Item]):
-        """Encode + launch back to back (the sync `resolve_batch` path, and
-        the composition the staged pipeline splits in two)."""
-        return self._run_plan(self._encode_plan(groups, items, pin=False))
-
     def _run_plan(self, plan: _Plan):
         """stage_dispatch: fire a plan's deferred kernel launches against
         its plan-time snapshots. Returns (packed, rpacked, kpacked) device
@@ -1478,6 +1749,11 @@ class BatchDepsResolver(DepsResolver):
         rpacked = kpacked = None
         if plan.range_call is not None:
             rpacked, kpacked = plan.range_call()
+        if packed is not None:
+            for g, fn in plan.fin_calls:
+                g.fin_dev = fn(packed)
+        for g, fn in plan.rfin_calls:
+            g.rfin_dev = fn()
         return packed, rpacked, kpacked
 
     def _encode_plan(self, groups: List[_Group], items: List[_Item],
@@ -1521,6 +1797,10 @@ class BatchDepsResolver(DepsResolver):
         gkeys: List[List[Tuple[int, _Item]]] = [[] for _ in groups]
         givs: List[List[Tuple[int, int, int]]] = [[] for _ in groups]
         ghull = [False] * len(groups)
+        # finalize_on_device: each group's (local interval-CSR entry, global
+        # item position, key) records -- key-subject point entries are 1:1
+        # with keys, so the finalized range output routes by entry
+        grents: List[List[Tuple[int, int, object]]] = [[] for _ in groups]
         for gi, g in enumerate(groups):
             ranges = g.arena.ranges
             for i, item in zip(g.idx, g.items):
@@ -1543,16 +1823,23 @@ class BatchDepsResolver(DepsResolver):
                 givs[gi].extend((i, s, e) for (s, e) in ivs)
             if ranges.encode_ok and ranges.count > 0:
                 # key subjects stab their store's interval rows with point
-                # intervals (the retired host_range_deps union, on device)
+                # intervals (the retired host_range_deps union, on device);
+                # the key-parallel encoding feeds the candidate kernel the
+                # exact same pairs encode_seekable_intervals would
                 for i, item in gkeys[gi]:
-                    ivs = encode_seekable_intervals(item.owned)
-                    if ivs is None:
+                    kivs = encode_key_point_intervals(item.owned)
+                    if kivs is None:
                         # unencodable keys: this subject's range deps come
                         # from the host union instead (counted)
                         item.fallback = "range"
                         self.range_fallbacks += 1
                         continue
-                    givs[gi].extend((i, s, e) for (s, e) in ivs)
+                    if self.finalize_on_device:
+                        base = len(givs[gi])
+                        grents[gi].extend(
+                            (base + t, i, k)
+                            for t, (k, _, _) in enumerate(kivs))
+                    givs[gi].extend((i, s, e) for (_, s, e) in kivs)
         # -- key-domain kernel plan --------------------------------------
         plan = _Plan(items, groups)
         k_parts = [(gi, g) for gi, g in enumerate(groups)
@@ -1603,6 +1890,11 @@ class BatchDepsResolver(DepsResolver):
                     j_keys=j_keys, j_store=j_store, j_sb=j_sb, j_sknd=j_sknd:
                     self._run_fused_kernel(ksnaps, j_slots, j_of, j_keys,
                                            j_store, j_sb, j_sknd))
+        if self.finalize_on_device and k_parts:
+            # per-store finalize_csr plan: consumes the packed result at
+            # launch time, so it rides the same deferred-call pipeline
+            for gi, g in k_parts:
+                self._plan_key_finalize(plan, g, gkeys[gi], b)
         # -- range kernel plan -------------------------------------------
         intervals = [t for gv in givs for t in gv]
         r_parts = [(gi, g) for gi, g in enumerate(groups)
@@ -1667,6 +1959,17 @@ class BatchDepsResolver(DepsResolver):
                     return (rp if has_r else None, kp if has_k else None)
 
                 plan.range_call = range_call
+            if self.finalize_on_device:
+                self._plan_range_finalize(plan, groups, grents, givs, nv,
+                                          j_iv, j_sb, j_sknd)
+        if self.finalize_on_device:
+            # the finalized harvest reads only the compacted CSR triples;
+            # the raw candidate buffers stay device-resident unless a range
+            # SUBJECT needs the candidate decode (or a fallback fetches
+            # them lazily)
+            has_rsub = any(not isinstance(item.owned, Keys)
+                           and item.fallback is None for item in items)
+            plan.want = (False, has_rsub, has_rsub)
         if pin:
             for g in groups:
                 if g.pk is not None or g.kp is not None:
@@ -1676,6 +1979,97 @@ class BatchDepsResolver(DepsResolver):
                     g.arena.ranges.pin_gen()
                     g.rpinned = True
         return plan
+
+    def _plan_key_finalize(self, plan: _Plan, g: _Group, pairs, b: int) -> None:
+        """Cut one store's finalize_csr call: the (subject, key) slot list
+        in the EXACT order the legacy decode walks it (item order, keys
+        sorted unique, keys without a row mask skipped -- bit-identity
+        depends on this), the device kid/row-mask inputs, and an out_cap
+        tier sized from the exact per-key live-row popcount bound (the
+        compaction output can never overflow it while kseq holds)."""
+        import jax.numpy as jnp
+        from accord_tpu.ops.kernels import finalize_csr, nnz_tier, out_tier
+        arena = g.arena
+        pos_of = {i: j for j, i in enumerate(g.idx)}
+        flat_key: List[object] = []
+        slot_subj: List[int] = []
+        slot_kid: List[int] = []
+        key_cnt = np.zeros(len(g.items), np.int64)
+        bound = 0
+        for i, item in pairs:
+            cnt = 0
+            for k in item.owned:    # Keys iterates sorted unique
+                if arena.key_rows.get(k) is None:
+                    continue
+                flat_key.append(k)
+                slot_subj.append(i)
+                slot_kid.append(arena.kid_of[k])
+                bound += arena.key_pop.get(k, 0)
+                cnt += 1
+            key_cnt[pos_of[i]] = cnt
+        key_off = np.concatenate(([0], np.cumsum(key_cnt)))
+        g.fin_slots = (flat_key, key_off)
+        if not flat_key:
+            return      # no key has arena rows: the group decodes to EMPTY
+        s = nnz_tier(len(flat_key))
+        out_cap = out_tier(max(bound, 1))
+        # padding slots use subject == b / kid == kid_cap: out of bounds,
+        # masked off inside the kernel
+        a_subj = np.full(s, b, dtype=np.int32)
+        a_subj[:len(slot_subj)] = slot_subj
+        a_kid = np.full(s, arena.kid_cap, dtype=np.int32)
+        a_kid[:len(slot_kid)] = slot_kid
+        subj_row = np.full(b, -1, dtype=np.int32)
+        for i, item in pairs:
+            subj_row[i] = arena.row_of.get(item.txn_id, -1)
+        kid_rows = arena.kid_arrays()
+        act_ts = arena.device_arrays()[1]
+        j_subj = jnp.asarray(a_subj)
+        j_kid = jnp.asarray(a_kid)
+        j_srow = jnp.asarray(subj_row)
+        j_off = jnp.asarray(g.pk[0], jnp.int32)
+        plan.fin_calls.append((g, lambda packed, kid_rows=kid_rows,
+                               j_subj=j_subj, j_kid=j_kid, j_srow=j_srow,
+                               j_off=j_off, act_ts=act_ts, oc=out_cap:
+                               finalize_csr(packed, j_off, kid_rows, j_subj,
+                                            j_kid, j_srow, act_ts,
+                                            out_cap=oc)))
+
+    def _plan_range_finalize(self, plan: _Plan, groups: List[_Group],
+                             grents, givs, nv: int, j_iv, j_sb,
+                             j_sknd) -> None:
+        """Cut each participating store's range_finalize_csr call: map the
+        group's local key-subject point entries onto global interval-CSR
+        positions, gate them with ent_ok, and close over the group's OWN
+        interval-arena snapshot -- the exact stab reruns against the real
+        endpoint lanes, so the fused candidate buffer is not an input."""
+        import jax.numpy as jnp
+        from accord_tpu.ops.kernels import out_tier, range_finalize_csr
+        offs, off = [], 0
+        for gv in givs:
+            offs.append(off)
+            off += len(gv)
+        for gi, g in enumerate(groups):
+            ents = grents[gi]
+            ranges = g.arena.ranges
+            if not ents or ranges.count == 0 or not ranges.encode_ok:
+                continue
+            pos_of = {i: j for j, i in enumerate(g.idx)}
+            base = offs[gi]
+            g.rents = [(base + lp, pos_of[i], k) for lp, i, k in ents]
+            ent_ok = np.zeros(nv, dtype=bool)
+            for e, _, _ in g.rents:
+                ent_ok[e] = True
+            nvalid = int(np.count_nonzero(ranges.valid[:ranges.count]))
+            out_cap = out_tier(max(len(g.rents) * nvalid, 1))
+            rsnap = ranges.device_arrays()
+            j_ok = jnp.asarray(ent_ok)
+            plan.rfin_calls.append((g, lambda rsnap=rsnap, j_ok=j_ok,
+                                    oc=out_cap:
+                                    range_finalize_csr(
+                                        j_iv[0], j_iv[1], j_iv[2], j_ok,
+                                        j_sb, j_sknd, *rsnap, self._table,
+                                        out_cap=oc)))
 
     def _run_kernel(self, ksnap, subj_of, subj_keys, sb, sknd):
         """The single-store kernel call against a plan-time arena snapshot
@@ -1697,42 +2091,50 @@ class BatchDepsResolver(DepsResolver):
                                   self._table)
 
     # -- pad_store_tiers helpers ----------------------------------------------
-    def _pad_key_block(self):
+    def _pad_key_block(self, cap: Optional[int] = None):
         """Cached all-invalid key-arena block for pad_store_tiers, shaped
-        like a fresh arena so padded dispatches share the max-tier compiled
-        shape. Invalid rows contribute nothing, and the dummy word columns
-        sit beyond every real group's span, so decode never sees them."""
-        if self._pad_key is None:
+        like an arena at `cap` rows so padded dispatches share the compiled
+        shape of their widest real block. Invalid rows contribute nothing,
+        and the dummy word columns sit beyond every real group's span, so
+        decode never sees them. Cached per capacity: when a real arena
+        outgrows initial_cap the pool grows a matching block alongside the
+        old ones instead of forcing a shape mismatch."""
+        cap = cap or self.initial_cap
+        blk = self._pad_key.get(cap)
+        if blk is None:
             import jax.numpy as jnp
-            cap = self.initial_cap
-            self._pad_key = (
+            blk = self._pad_key[cap] = (
                 jnp.zeros((cap, self.num_buckets), jnp.float32),
                 jnp.zeros((cap, 3), jnp.int32),
                 jnp.zeros(cap, jnp.int32),
                 jnp.zeros(cap, bool))
-        return self._pad_key
+        return blk
 
-    def _pad_range_block(self):
-        if self._pad_range is None:
+    def _pad_range_block(self, cap: Optional[int] = None):
+        cap = cap or self.range_cap
+        blk = self._pad_range.get(cap)
+        if blk is None:
             import jax.numpy as jnp
-            rc = self.range_cap
-            self._pad_range = (
-                jnp.zeros(rc, jnp.int32), jnp.zeros(rc, jnp.int32),
-                jnp.zeros((rc, 3), jnp.int32), jnp.zeros(rc, jnp.int32),
-                jnp.zeros(rc, bool))
-        return self._pad_range
+            blk = self._pad_range[cap] = (
+                jnp.zeros(cap, jnp.int32), jnp.zeros(cap, jnp.int32),
+                jnp.zeros((cap, 3), jnp.int32), jnp.zeros(cap, jnp.int32),
+                jnp.zeros(cap, bool))
+        return blk
 
     def _pad_fused(self, blocks: list, slots, pad_block):
         """pad_store_tiers: top a fused call's block list up to the fixed
         store tier with cached empty blocks under slot -1 (no subject's
         store-id lane is negative, so dummies match nothing). Trades a
         little extra readback width per dummy for ONE compiled jit tier
-        across all participating-store counts up to the tier."""
+        across all participating-store counts up to the tier. Dummies take
+        the widest real block's capacity so the compiled shape tracks arena
+        growth."""
         tier = self.pad_store_tiers
         if not tier or len(blocks) >= tier:
             return slots
         import jax.numpy as jnp
-        pad = pad_block()
+        cap = max(b[0].shape[0] for b in blocks) if blocks else None
+        pad = pad_block(cap)
         npad = tier - len(blocks)
         blocks.extend([pad] * npad)
         self.padded_dispatches += 1
@@ -1851,6 +2253,21 @@ class BatchDepsResolver(DepsResolver):
                 >> (e_row & 31).astype(np.uint32)) & 1).astype(bool)
         h_slot = slot[hit]
         h_row = e_row[hit]
+        return self._assemble_key_deps(arena, items, h_slot, h_row, flat_key,
+                                       flat_cov, covered_any, slot_item,
+                                       key_off, out)
+
+    def _assemble_key_deps(self, arena: _StoreArena, items: List[_Item],
+                           h_slot: np.ndarray, h_row: np.ndarray,
+                           flat_key: list, flat_cov: list,
+                           covered_any: bool, slot_item: np.ndarray,
+                           key_off: np.ndarray, out: list) -> list:
+        """Steps 6-8 of the batch decode, shared verbatim by the legacy
+        unpackbits path and the finalized-CSR materialize (same flat-slot
+        layout, so the two paths stay bit-identical by construction): one
+        global (slot, rank) sort, covered-elision, per-item CSR slices."""
+        from accord_tpu.primitives.deps import KeyDeps
+        n = len(items)
         if h_slot.size == 0:
             return out
         # 6. one global sort: flat slots increase per (item, key), so
@@ -1900,6 +2317,94 @@ class BatchDepsResolver(DepsResolver):
             out[i] = KeyDeps(keys_present, txn_ids, offsets,
                              tuple(inv.tolist()))
         return out
+
+    def _fetch_np(self, holder, attr: str, dev):
+        """Lazy blocking host read of a device buffer, cached on its holder
+        (_Call for the raw candidate buffers, _Group for the finalized CSR
+        triples) and timed into readback_s -- the finalized path skips the
+        eager raw-buffer readback; fallbacks pay only for what they touch."""
+        import time as _time
+        cached = getattr(holder, attr)
+        if cached is not None:
+            return cached
+        if dev is None:
+            return None
+        t0 = _time.perf_counter()
+        val = _dev_read(dev)
+        self.readback_s += _time.perf_counter() - t0
+        setattr(holder, attr, val)
+        return val
+
+    def _materialize_finalized(self, call: _Call, g: _Group):
+        """Slice-and-wrap: one store's key-domain deps straight from the
+        device-finalized (indptr, dep_rows) CSR -- no unpackbits, no
+        membership gather, no row translation (kseq/gen guards upstream
+        certify rows and slots still mean what the kernel saw). Returns
+        [KeyDeps] per group item, or None when the compaction overflowed
+        its out_cap tier (caller falls back to the legacy decode)."""
+        from accord_tpu.primitives.deps import KeyDeps
+        arena = g.arena
+        items = g.items
+        n = len(items)
+        flat_key, key_off = g.fin_slots
+        out = [KeyDeps.EMPTY] * n
+        if not flat_key:
+            return out      # no key had arena rows at plan time
+        buf = self._fetch_np(g, "fin_np", g.fin_dev)
+        if buf is None:
+            return None     # kernel never launched (defensive)
+        indptr, dep_rows, _ = buf
+        ns = len(flat_key)
+        total = int(indptr[ns])
+        if total > dep_rows.shape[0]:
+            return None     # out_cap overflow (kseq changed mid-flight)
+        h_slot = np.repeat(np.arange(ns), np.diff(indptr[:ns + 1]))
+        h_row = dep_rows[:total].astype(np.int64)
+        # covered maps are read at HARVEST time in both paths (the legacy
+        # decode builds flat_cov here too), so elision stays in lockstep
+        flat_cov: List[Optional[dict]] = []
+        covered_any = False
+        # slot_item: which item owns each flat slot (key_off is per-item)
+        slot_item = np.repeat(np.arange(n), np.diff(key_off))
+        for s in range(ns):
+            cfks = items[int(slot_item[s])].store.cfks
+            c = cfks.get(flat_key[s])
+            cov = c.covered if c is not None and c.covered else None
+            flat_cov.append(cov)
+            covered_any = covered_any or cov is not None
+        return self._assemble_key_deps(arena, items, h_slot, h_row, flat_key,
+                                       flat_cov, covered_any, slot_item,
+                                       key_off, out)
+
+    def _materialize_range_finalized(self, call: _Call, g: _Group):
+        """Key subjects' range-txn deps from the device-exact stab: each
+        point-interval entry's CSR segment holds the rows whose interval,
+        witness, and before tests ALL passed on device, so the host work is
+        row -> txn id and builder insertion. None -> overflow or no buffer
+        (caller falls back to the candidate decode)."""
+        if g.rfin_dev is None and g.rfin_np is None:
+            return None
+        buf = self._fetch_np(g, "rfin_np", g.rfin_dev)
+        indptr, dep_rows, _ = buf
+        if int(indptr[-1]) > dep_rows.shape[0]:
+            return None     # out_cap overflow (rseq changed mid-flight)
+        ranges = g.arena.ranges
+        ids = ranges.ids_np
+        builders: Dict[int, KeyDepsBuilder] = {}
+        for e, j, k in g.rents:
+            lo, hi = int(indptr[e]), int(indptr[e + 1])
+            if lo == hi:
+                continue
+            item = g.items[j]
+            kb = builders.get(j)
+            if kb is None:
+                kb = builders[j] = KeyDepsBuilder()
+            for row in dep_rows[lo:hi]:
+                rid = ids[row]
+                if rid is None or rid == item.txn_id:
+                    continue
+                kb.add(k, rid)
+        return {j: kb.build() for j, kb in builders.items()}
 
     def _decode_key_range_deps(self, arena: _StoreArena, rgen: int,
                                rprow: np.ndarray, item: _Item):
@@ -2001,16 +2506,50 @@ class BatchDepsResolver(DepsResolver):
         for g in call.groups:
             arena = g.arena
             idx = np.asarray(g.idx, np.int64)
-            gp = call.np_packed[idx][:, g.pk[0]:g.pk[1]] \
-                if call.np_packed is not None and g.pk is not None else None
-            grp = call.np_rpacked[idx][:, g.rp[0]:g.rp[1]] \
-                if call.np_rpacked is not None and g.rp is not None else None
-            gkp = call.np_kpacked[idx][:, g.kp[0]:g.kp[1]] \
-                if call.np_kpacked is not None and g.kp is not None else None
-            key_stale = gp is not None and g.gen != arena.gen
+            has_pk = (call.packed is not None or call.np_packed is not None) \
+                and g.pk is not None
+            has_rp = (call.rpacked is not None
+                      or call.np_rpacked is not None) and g.rp is not None
+            has_kp = (call.kpacked is not None
+                      or call.np_kpacked is not None) and g.kp is not None
+            key_stale = has_pk and g.gen != arena.gen
+            gp = grp = gkp = None
             kds = None
-            if gp is not None and not key_stale:
-                kds = self._decode_batch(arena, g.items, gp)
+            if g.fin_slots is not None and not key_stale \
+                    and g.kseq == arena.kseq:
+                # device-finalized CSR harvest: exact rows, no raw readback
+                # (empty slot list short-circuits to all-EMPTY inside)
+                kds = self._materialize_finalized(call, g)
+                if kds is not None:
+                    self.finalized_decodes += 1
+            if kds is None and has_pk:
+                if g.fin_slots is not None:
+                    self.finalize_fallbacks += 1
+                buf = self._fetch_np(call, "np_packed", call.packed)
+                gp = buf[idx][:, g.pk[0]:g.pk[1]]
+                if not key_stale:
+                    kds = self._decode_batch(arena, g.items, gp)
+                    self.legacy_decodes += 1
+            # range finalized output: exact per-entry segments for this
+            # group's KEY subjects (range subjects keep the candidate decode)
+            rkb = None
+            if g.rents is not None and g.rgen == arena.ranges.gen \
+                    and g.rseq == arena.ranges.rseq:
+                rkb = self._materialize_range_finalized(call, g)
+            if g.rents is not None and rkb is None:
+                self.finalize_fallbacks += 1
+            need_rp = has_rp and (
+                rkb is None
+                or any(not isinstance(it.owned, Keys) for it in g.items))
+            if need_rp:
+                buf = self._fetch_np(call, "np_rpacked", call.rpacked)
+                if buf is not None:
+                    grp = buf[idx][:, g.rp[0]:g.rp[1]]
+            if has_kp and any(not isinstance(it.owned, Keys)
+                              for it in g.items):
+                buf = self._fetch_np(call, "np_kpacked", call.kpacked)
+                if buf is not None:
+                    gkp = buf[idx][:, g.kp[0]:g.kp[1]]
             for j, item in enumerate(g.items):
                 store = item.store
                 if item.fallback == "full":
@@ -2036,7 +2575,7 @@ class BatchDepsResolver(DepsResolver):
                     continue
                 if kds is not None:
                     kd = kds[j]
-                elif key_stale:
+                elif key_stale and gp is not None:
                     rows = arena.translate_rows(g.gen, _unpack_row(gp[j]))
                     if rows is None:
                         self.host_fallbacks += 1
@@ -2053,6 +2592,10 @@ class BatchDepsResolver(DepsResolver):
                     if store.range_txns:
                         deps = deps.union(store.host_range_deps(
                             item.txn_id, item.owned, item.before))
+                elif rkb is not None:
+                    extra = rkb.get(j)
+                    if extra is not None and not extra.is_empty():
+                        deps = deps.union(Deps(extra))
                 elif grp is not None:
                     extra = self._decode_key_range_deps(arena, g.rgen,
                                                         grp[j], item)
@@ -2113,11 +2656,11 @@ class BatchDepsResolver(DepsResolver):
         else:
             t0 = _time.perf_counter()
             packed, rpacked, kpacked = self._run_plan(plan)
-            for buf in (packed, rpacked, kpacked):
-                if buf is not None:
-                    buf.copy_to_host_async()
+            call = _Call(packed, rpacked, kpacked, plan.items, plan.groups,
+                         plan.want)
+            for _, _, dev in call.buffers():
+                _dev_copy_async(dev)
             self.dispatch_s += _time.perf_counter() - t0
-            call = _Call(packed, rpacked, kpacked, plan.items, plan.groups)
         self.dispatches += 1
         if staged:
             self.staged_dispatches += 1
@@ -2168,13 +2711,13 @@ class BatchDepsResolver(DepsResolver):
         def prefetch() -> bool:
             for call in q:
                 done = True
-                for attr, buf in call.buffers():
-                    if buf is None or getattr(call, attr) is not None:
+                for holder, attr, dev in call.buffers():
+                    if getattr(holder, attr) is not None:
                         continue
-                    if not buf.is_ready():
+                    if not _dev_ready(dev):
                         done = False
                         break
-                    setattr(call, attr, np.asarray(buf))
+                    setattr(holder, attr, _dev_read(dev))
                 if not done:
                     break  # single device stream: later calls finish later
             if q:
@@ -2192,8 +2735,11 @@ class BatchDepsResolver(DepsResolver):
         call = q.popleft()
         if call.has_device:
             t0 = _time.perf_counter()
-            if call.fetch():
-                self.harvest_stall_s += _time.perf_counter() - t0
+            stalled = call.fetch()
+            ft = _time.perf_counter() - t0
+            self.readback_s += ft
+            if stalled:
+                self.harvest_stall_s += ft
             else:
                 self.prefetched += 1
         t0 = _time.perf_counter()
@@ -2201,6 +2747,7 @@ class BatchDepsResolver(DepsResolver):
                or (g.rp is not None and g.rgen != g.arena.ranges.gen)
                for g in call.groups):
             self.stale_harvests += 1
+        rb0 = self.readback_s
         results = self._decode_dispatch(call)
         for g in call.groups:
             if g.pinned:
@@ -2209,6 +2756,9 @@ class BatchDepsResolver(DepsResolver):
                 g.arena.ranges.unpin_gen(g.rgen)
         dt = _time.perf_counter() - t0
         self.decode_s += dt
+        # lazy fallback fetches inside the decode were timed into readback_s;
+        # what's left is pure host materialization
+        self.materialize_s += dt - (self.readback_s - rb0)
         if q:
             # calls still in flight behind this one: stage_decode ran
             # inside their device window
@@ -2245,8 +2795,9 @@ class BatchDepsResolver(DepsResolver):
         if arena.count == 0 and arena.ranges.count == 0:
             call = _Call(None, None, None, items, [g])
         else:
-            packed, rpacked, kpacked = self._encode_and_run([g], items)
-            call = _Call(packed, rpacked, kpacked, items, [g])
+            plan = self._encode_plan([g], items, pin=False)
+            packed, rpacked, kpacked = self._run_plan(plan)
+            call = _Call(packed, rpacked, kpacked, items, [g], plan.want)
             call.fetch()
         return self._decode_core(call)
 
@@ -2325,11 +2876,15 @@ class ShardedBatchDepsResolver(BatchDepsResolver):
     def __init__(self, mesh=None, num_buckets: int = 256,
                  initial_cap: int = 4096, fuse_cross_store: bool = True,
                  overlap_host: bool = True,
-                 pad_store_tiers: Optional[int] = None):
+                 pad_store_tiers: Optional[int] = None,
+                 finalize_on_device: bool = True,
+                 adaptive_window: bool = False, kid_cap: int = 4096):
         super().__init__(num_buckets, initial_cap,
                          fuse_cross_store=fuse_cross_store,
                          overlap_host=overlap_host,
-                         pad_store_tiers=pad_store_tiers)
+                         pad_store_tiers=pad_store_tiers,
+                         finalize_on_device=finalize_on_device,
+                         adaptive_window=adaptive_window, kid_cap=kid_cap)
         from accord_tpu.parallel.mesh import make_mesh
         self.mesh = mesh if mesh is not None else make_mesh()
         data = self.mesh.shape["data"]

@@ -15,6 +15,18 @@ The execute-order closure all-gathers row blocks each squaring round
 step -- deps matrix + adjacency closure + execution wavefronts -- as one
 shard_map program jitted over the mesh; this is the multi-chip path the
 driver dry-runs and the scale-out story for >1 chip.
+
+Finalized-CSR harvest on the sharded path: the resolver's finalize kernels
+(ops.kernels.finalize_csr / range_finalize_csr) are plain jits consuming
+whatever packed result the (sharded) candidate kernels produced -- jit
+auto-reshards the lane-sharded packed words against the single-device kid
+table and interval lanes, so ShardedBatchDepsResolver inherits the
+device-side exact filtering + segment compaction without a mesh-specific
+twin. Lane order equals row order (cap % (32 * data) == 0), which is the
+property the finalize kernels' word indexing relies on. A real multi-chip
+deployment would shard the compaction itself (per-device segment counts +
+a cross-device exclusive scan); on the virtual CPU mesh the reshard cost
+is noise, so that remains an open scale-out item.
 """
 from __future__ import annotations
 

@@ -36,6 +36,7 @@ class ClusterConfig:
                  durability: bool = False, durability_interval_ms: float = 500.0,
                  preaccept_timeout_ms: float = 1000.0,
                  exec_plane: bool = False, exec_tick_ms: float = 2.0,
+                 exec_fuse: bool = True,
                  store_delays: bool = False, store_delay_max_us: int = 2000,
                  clock_drift: bool = False, clock_offset_max_us: int = 100_000,
                  clock_drift_max_ppm: int = 10_000):
@@ -75,6 +76,9 @@ class ClusterConfig:
         # wavefronts from the device frontier kernel instead of the host walk
         self.exec_plane = exec_plane
         self.exec_tick_ms = exec_tick_ms
+        # fuse the exec planes' per-store frontier calls into one per-node
+        # dispatch (ExecCoordinator); solo planes keep the plain kernel
+        self.exec_fuse = exec_fuse
         # adversarial simulator knobs (reference: DelayedCommandStores async
         # loads + per-node clock drift, burn/BurnTest.java:330-340)
         self.store_delays = store_delays
@@ -208,6 +212,7 @@ class Cluster:
         self.nodes: Dict[NodeId, Node] = {}
         self.stores: Dict[NodeId, ListStore] = {}
         self.progress_engines: Dict[NodeId, object] = {}
+        self.exec_coordinators: Dict[NodeId, object] = {}
         self.topology_service = SimTopologyService(self, self.topology)
         # crash/restart machinery (reference: test Journal + pseudo-restart):
         # per-node liveness cells (kill ghost timers), per-node constructor
@@ -284,12 +289,29 @@ class Cluster:
         if engine is not None:
             engine.bind(node)
             self.progress_engines[node_id] = engine
+        # zero-config tier padding: when the resolver supports
+        # pad_store_tiers and the caller didn't pick one, derive it from
+        # the wiring-time store count -- fused dispatches then compile one
+        # store tier no matter how many stores a slice touches
+        resolver = node._deps_resolver
+        if resolver is not None \
+                and getattr(resolver, "pad_store_tiers", 0) is None \
+                and self.config.stores_per_node > 1:
+            resolver.pad_store_tiers = self.config.stores_per_node
         if self.config.exec_plane:
-            from accord_tpu.ops.exec_plane import ExecPlane
+            from accord_tpu.ops.exec_plane import ExecCoordinator, ExecPlane
+            coordinator = None
+            if self.config.exec_fuse and self.config.stores_per_node > 1:
+                coordinator = ExecCoordinator(
+                    node, tick_ms=self.config.exec_tick_ms,
+                    device_latency_ms=self.config.device_latency_ms)
+                self.exec_coordinators[node_id] = coordinator
             for store in node.command_stores.all():
                 store.exec_plane = ExecPlane(
                     store, tick_ms=self.config.exec_tick_ms,
                     device_latency_ms=self.config.device_latency_ms)
+                if coordinator is not None:
+                    coordinator.register(store.exec_plane)
         if self.config.store_delays:
             # async store-op delays (reference: DelayedCommandStores): each
             # store defers every op by a deterministic random delay,

@@ -81,7 +81,8 @@ def bench_pipeline(quick: bool):
     subjects_n = 128 if quick else PIPE_SUBJECTS
 
     resolver = BatchDepsResolver(num_buckets=PIPE_BUCKETS, initial_cap=PIPE_CAP,
-                                 max_dispatch=PIPE_BATCH)
+                                 max_dispatch=PIPE_BATCH,
+                                 adaptive_window=True)
     cluster = Cluster(3, ClusterConfig(
         num_nodes=1, rf=1, stores_per_node=1, num_shards=1,
         progress=False, deps_resolver_factory=lambda: resolver,
@@ -186,6 +187,13 @@ def bench_pipeline(quick: bool):
     pre0 = resolver.prefetched
     stale0 = resolver.stale_harvests
     fall0 = resolver.host_fallbacks
+    rb0 = resolver.readback_s
+    mat0 = resolver.materialize_s
+    fin0 = resolver.finalized_decodes
+    leg0 = resolver.legacy_decodes
+    ff0 = resolver.finalize_fallbacks
+    ws0 = resolver.window_shrinks
+    ww0 = resolver.window_widens
     from accord_tpu.ops.kernels import jit_cache_sizes
     cache0 = jit_cache_sizes()   # warmup must have covered every jit tier
     chunk_walls = []
@@ -232,6 +240,22 @@ def bench_pipeline(quick: bool):
         raise AssertionError(
             "staged pipeline disengaged in the large replay "
             "(no encode-ahead launches)")
+    # finalized-CSR harvest engaged for EVERY group: the legacy unpackbits
+    # decode must not have run at all in the timed window
+    if resolver.legacy_decodes != leg0:
+        raise AssertionError(
+            f"finalized path disengaged: {resolver.legacy_decodes - leg0} "
+            "groups fell back to the legacy unpackbits decode in the "
+            "large replay")
+    if resolver.finalized_decodes == fin0:
+        raise AssertionError(
+            "finalized-CSR harvest never engaged in the large replay")
+    # adaptive staged window: the bursty admission pattern must have moved
+    # the per-node window scale at least once over the pipeline bench
+    if resolver.window_shrinks + resolver.window_widens == 0:
+        raise AssertionError(
+            "adaptive window never adapted (no shrinks or widens across "
+            "the pipeline bench)")
     phase_s = {
         "preaccept_s": resolver.preaccept_s - pa0,
         "encode_s": resolver.encode_s - enc0,
@@ -281,6 +305,16 @@ def bench_pipeline(quick: bool):
             "encode_s": round(phase_s["encode_s"], 2),
             "dispatch_s": round(phase_s["dispatch_s"], 2),
             "decode_s": round(phase_s["decode_s"], 2),
+            # decode split: device->host transfer time vs host-side CSR
+            # slice-and-wrap (the finalized path turns the latter into
+            # searchsorted + array slicing over the compacted readback)
+            "readback_s": round(resolver.readback_s - rb0, 2),
+            "materialize_s": round(resolver.materialize_s - mat0, 2),
+            "finalized_decodes": resolver.finalized_decodes - fin0,
+            "legacy_decodes": resolver.legacy_decodes - leg0,
+            "finalize_fallbacks": resolver.finalize_fallbacks - ff0,
+            "window_shrinks": resolver.window_shrinks - ws0,
+            "window_widens": resolver.window_widens - ww0,
             "harvest_stall_s": round(resolver.harvest_stall_s - stall0, 2),
             "host_hidden_s": round(hidden_s, 2),
             "host_hidden_pct": round(host_hidden_pct, 1),
@@ -362,10 +396,21 @@ def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool,
     if device:
         from accord_tpu.ops.kernels import jit_cache_sizes
         cache1 = jit_cache_sizes()
-        if cache1 != cache0:
+        # the finalize compaction out-caps are data-dependent pow2 buckets
+        # (sized from each dispatch's exact popcount bound), as are the
+        # kid-table dirty-word buckets: a contended burn can mint a new
+        # bucket at most once, ever, per shape. The large-replay bench
+        # asserts those kernels strictly (its tiers are predictable and
+        # pre-warmed); here every OTHER kernel must stay at zero.
+        data_tiered = ("finalize_csr", "range_finalize_csr",
+                       "kid_word_scatter")
+        drift = {k: (cache0[k], cache1[k]) for k in cache1
+                 if cache1[k] != cache0[k] and k not in data_tiered}
+        if drift:
             raise AssertionError(
-                f"jit tiers compiled inside the e2e burn: {cache0} -> "
-                f"{cache1} (warmup store_tiers coverage is stale)")
+                f"jit tiers compiled inside the e2e burn: {drift} "
+                "(warmup store_tiers coverage is stale)")
+        finalize_compiles = sum(cache1[k] - cache0[k] for k in data_tiered)
         dispatches = sum(r.dispatches for r in resolvers)
         ticks = sum(r.ticks for r in resolvers)
         # fused cross-store dispatch engaged: a per-store drain would pay
@@ -374,6 +419,12 @@ def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool,
             raise AssertionError(
                 f"fused dispatch disengaged: {dispatches} dispatches over "
                 f"{ticks} ticks with {cfg.stores_per_node} stores/node")
+        # finalized-CSR harvest engaged on the burn's device leg (legacy
+        # decodes still legitimately run for groups caught by a mid-flight
+        # truncation/compaction -- those are counted, not forbidden)
+        if dispatches and sum(r.finalized_decodes for r in resolvers) == 0:
+            raise AssertionError(
+                "finalized-CSR harvest never engaged in the e2e burn")
         ub = sum(r.upload_bytes for r in resolvers)
         ube = sum(r.upload_bytes_full_equiv for r in resolvers)
         # field-granular deltas pay off on this status-bump-heavy burn:
@@ -414,6 +465,13 @@ def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool,
             if phases else 0.0,
             "harvest_stall_s": round(sum(r.harvest_stall_s for r in resolvers), 2),
             "decode_s": round(sum(r.decode_s for r in resolvers), 2),
+            "readback_s": round(sum(r.readback_s for r in resolvers), 2),
+            "materialize_s": round(sum(r.materialize_s for r in resolvers), 2),
+            "finalized_decodes": sum(r.finalized_decodes for r in resolvers),
+            "legacy_decodes": sum(r.legacy_decodes for r in resolvers),
+            "finalize_fallbacks": sum(r.finalize_fallbacks
+                                      for r in resolvers),
+            "finalize_tier_compiles": finalize_compiles,
             "prefetched": sum(r.prefetched for r in resolvers),
             "stale_harvests": sum(r.stale_harvests for r in resolvers),
             "host_fallbacks": sum(r.host_fallbacks for r in resolvers),
@@ -818,6 +876,26 @@ def main(argv=None) -> int:
                batch_tiers=(8, 64, 128, 256, 512, PIPE_BATCH),
                scatter_tiers=(8, 64),
                nnz_tiers=(32, 256, 2048, 4096), store_tiers=(1,))
+        # finalized-CSR compaction tiers, matched per batch tier: out_cap
+        # is the dispatch's exact popcount bound padded to a tier, and for
+        # this workload bound ~= flat_keys x mean key population (~40 full,
+        # ~8 quick). A dispatch padded to batch tier T carries anywhere
+        # from prev_tier+1 to T real subjects, so each tier's bound spans
+        # a RANGE of out buckets (both bench modes included); nnz edge
+        # tiers cover in-item key dupes dipping flat_keys under a
+        # boundary. Key-only workload: skip the range compaction tiers.
+        for bt, nts, outs in (
+                (8, (32,), (256, 2048)),
+                (64, (256,), (2048, 16384)),
+                (128, (256, 2048), (2048, 16384, 32768)),
+                (256, (2048,), (16384, 32768, 65536)),
+                (512, (2048,), (16384, 32768, 65536, 131072)),
+                (PIPE_BATCH, (2048, 4096),
+                 (16384, 32768, 65536, 131072, 262144)),
+        ):
+            warmup(num_buckets=PIPE_BUCKETS, cap=PIPE_CAP, batch_tiers=(bt,),
+                   scatter_tiers=(), nnz_tiers=nts, store_tiers=(1,),
+                   out_tiers=outs, range_out_tiers=())
         warm_s = time.perf_counter() - t0
 
         pipeline = bench_pipeline(args.quick)
