@@ -28,6 +28,7 @@ from accord_tpu.messages import (
     Commit, CommitOk, PreAccept, PreAcceptNack, PreAcceptOk, ReadNack, ReadOk,
     ReadTxnData,
 )
+from accord_tpu.obs.trace import REC, node_pid, node_ts
 from accord_tpu.primitives.deps import Deps
 from accord_tpu.primitives.routes import Route
 from accord_tpu.primitives.timestamp import Ballot, Timestamp, TxnId
@@ -49,10 +50,16 @@ class CoordinateTransaction:
             route, txn_id.epoch, txn_id.epoch)
         self.execute_at: Optional[Timestamp] = None
         self.deps: Deps = Deps.NONE
+        # coordination start in sim microseconds: the txn.commit_latency_us /
+        # txn.apply_latency_us histograms and the trace span anchor here
+        self.t0_us = node.time_service.now_micros()
 
     @classmethod
     def coordinate(cls, node, txn_id: TxnId, txn: Txn, route: Route) -> AsyncResult:
         self = cls(node, txn_id, txn, route)
+        node.metrics.counter("txn.started").inc()
+        if REC.enabled:
+            REC.txn_begin(node_pid(node), txn_id, self.t0_us)
         self._start_preaccept()
         return self.result
 
@@ -88,12 +95,18 @@ class CoordinateTransaction:
             self.deps = Deps.merge([ok.deps for ok in round_.oks.values()
                                     if ok.is_fast_path_vote])
             self.node.events.on_fast_path_taken(self.txn_id)
+            if REC.enabled:
+                REC.txn_step(node_pid(self.node), self.txn_id, "fast_path",
+                             node_ts(self.node))
             self._start_execute()
         else:
             self.execute_at = _merge_witnessed_all(
                 ok.witnessed_at for ok in round_.oks.values())
             self.deps = Deps.merge([ok.deps for ok in round_.oks.values()])
             self.node.events.on_slow_path_taken(self.txn_id)
+            if REC.enabled:
+                REC.txn_step(node_pid(self.node), self.txn_id, "slow_path",
+                             node_ts(self.node))
             if self.execute_at.is_rejected:
                 # a replica refused to witness us (behind an
                 # ExclusiveSyncPoint floor, or expired): invalidate instead of
@@ -178,6 +191,11 @@ class CoordinateTransaction:
 
     # -- phase 4: Persist (off the client latency path) ----------------------
     def _persist(self, writes, result) -> None:
+        now = node_ts(self.node)
+        self.node.metrics.histogram("txn.commit_latency_us").observe(
+            now - self.t0_us)
+        if REC.enabled:
+            REC.txn_step(node_pid(self.node), self.txn_id, "result", now)
         self.result.try_set_success(result)
         round_ = _ApplyRound(self, writes, result)
         round_.start()
@@ -186,6 +204,11 @@ class CoordinateTransaction:
     def _fail(self, failure: BaseException) -> None:
         if not self.result.done:
             self.node.events.on_timeout(self.txn_id)
+            self.node.metrics.counter("txn.failed").inc()
+            if REC.enabled:
+                REC.txn_end(node_pid(self.node), self.txn_id,
+                            node_ts(self.node),
+                            args={"failed": type(failure).__name__})
             self.result.set_failure(failure)
 
     @property
@@ -516,6 +539,14 @@ class _ApplyRound(Callback):
         if self._informed:
             return
         self._informed = True
+        t0 = getattr(self.parent, "t0_us", None)
+        if t0 is not None:
+            node = self.parent.node
+            now = node_ts(node)
+            node.metrics.histogram("txn.apply_latency_us").observe(now - t0)
+            if REC.enabled:
+                REC.txn_end(node_pid(node), self.parent.txn_id, now,
+                            args={"acked": len(self.acked)})
         from accord_tpu.local.status import Durability
         from accord_tpu.messages.inform import InformDurable
         p = self.parent

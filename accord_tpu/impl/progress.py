@@ -24,6 +24,7 @@ from typing import Dict, Optional
 
 from accord_tpu.api import ProgressLog
 from accord_tpu.local.status import Status
+from accord_tpu.obs.trace import REC, node_pid, node_ts
 from accord_tpu.primitives.keyspace import Keys, Seekables
 from accord_tpu.primitives.timestamp import TxnId
 
@@ -428,6 +429,11 @@ class ProgressEngine:
                 entry.attempts = 1
 
         self.node.counters["progress_probes"] += 1
+        if REC.enabled:
+            REC.instant(node_pid(self.node), "txn", "progress_probe",
+                        node_ts(self.node),
+                        args={"txn": str(entry.txn_id),
+                              "attempts": entry.attempts})
         # durable => the outcome exists on a quorum: never race to
         # invalidate it, just fetch (the InformDurable gossip's teeth)
         MaybeRecover.probe(self.node, entry.txn_id, entry.participants,
@@ -517,6 +523,10 @@ class StoreProgressLog(ProgressLog):
         self._track(command, is_home)
 
     def readyToExecute(self, command) -> None:
+        if REC.enabled:
+            node = self.engine.node
+            REC.txn_step(node_pid(node), command.txn_id, "ready_to_execute",
+                         node_ts(node))
         # the caller does not know whether this store is home: home=None
         # preserves the entry's existing classification instead of silently
         # promoting a non-home entry to home cadence

@@ -16,6 +16,7 @@ from accord_tpu.local.cfk import CfkStatus
 from accord_tpu.local.command import Command, WaitingOn
 from accord_tpu.local.status import Durability, Status
 from accord_tpu.local.store import CommandStore
+from accord_tpu.obs.trace import REC, node_pid, node_ts
 from accord_tpu.primitives.deps import Deps
 from accord_tpu.primitives.keyspace import Keys, Ranges
 from accord_tpu.primitives.routes import Route
@@ -32,6 +33,14 @@ class AcceptOutcome(enum.Enum):
     REDUNDANT = "redundant"
     REJECTED_BALLOT = "rejected_ballot"
     TRUNCATED = "truncated"
+
+
+def _rec_step(store: CommandStore, txn_id: TxnId, name: str) -> None:
+    """Replica-side lifecycle instant: one flow step on this node's txn
+    track, linking it into the coordinator's span (obs/trace.py). Callers
+    guard on REC.enabled so the disabled path stays a single attr check."""
+    node = store.node
+    REC.txn_step(node_pid(node), txn_id, name, node_ts(node))
 
 
 # ---------------------------------------------------------------------------
@@ -70,6 +79,8 @@ def preaccept(store: CommandStore, txn_id: TxnId, txn: PartialTxn, route: Route,
                                               permit_fast_path=(ballot == Ballot.ZERO))
         cmd.execute_at = witnessed
         cmd.status = Status.PRE_ACCEPTED
+        if REC.enabled:
+            _rec_step(store, txn_id, "preaccepted")
         store.register(txn_id, txn.keys, CfkStatus.WITNESSED, witnessed)
         store.progress_log.preaccepted(cmd, _is_home(store, cmd))
     else:
@@ -115,6 +126,8 @@ def accept(store: CommandStore, txn_id: TxnId, ballot: Ballot, route: Route,
         cmd.deps = deps.slice(store.ranges)
         cmd.accepted_scope = keys.to_ranges()
     cmd.status = Status.ACCEPTED
+    if REC.enabled:
+        _rec_step(store, txn_id, "accepted")
     store.register(txn_id, keys, CfkStatus.WITNESSED, execute_at)
     store.progress_log.accepted(cmd, _is_home(store, cmd))
     notify_listeners(store, cmd)
@@ -210,6 +223,8 @@ def commit(store: CommandStore, txn_id: TxnId, route: Route, txn: Optional[Parti
     cmd.execute_at = execute_at
     cmd.deps = deps
     cmd.status = Status.STABLE
+    if REC.enabled:
+        _rec_step(store, txn_id, "stable")
     store.register(txn_id, cmd.txn.keys, CfkStatus.COMMITTED,
                    max(execute_at, txn_id.as_timestamp()), execute_at)
     if txn_id.kind is TxnKind.WRITE and txn_id.domain is Domain.KEY:
@@ -394,6 +409,8 @@ def _do_apply(store: CommandStore, cmd: Command) -> None:
         # snapshot; re-applying here would double-write
         cmd.writes.apply_to(store, store.apply_ranges_for(cmd.txn_id))
     cmd.status = Status.APPLIED
+    if REC.enabled:
+        _rec_step(store, cmd.txn_id, "applied")
     cmd.durability = cmd.durability.merge(Durability.LOCAL)
     if cmd.txn_id.kind is TxnKind.EXCLUSIVE_SYNC_POINT:
         # every conflicting txn below the ESP has now applied locally

@@ -47,6 +47,14 @@ class Node:
         # lightweight observability: protocol event counts (probes sent,
         # informs exchanged, ...); the burn report and gossip tests read them
         self.counters: collections.Counter = collections.Counter()
+        # unified metrics: txn lifecycle counters/latency histograms land
+        # here; metrics_snapshot() folds in every attached resolver's and
+        # exec plane's registry (obs/metrics.py)
+        from accord_tpu.obs.metrics import MetricsRegistry
+        self.metrics = MetricsRegistry()
+        # str -> None sink for emit_metrics_snapshot (the maelstrom runner
+        # points it at its stderr logger); None: snapshots are not emitted
+        self.metrics_sink: Optional[Callable[[str], None]] = None
         self.message_sink = message_sink
         self.config_service = config_service
         self.scheduler = scheduler
@@ -225,24 +233,56 @@ class Node:
         else:
             self.receive(request, self.id, None)
 
+    # -- observability -------------------------------------------------------
+    def metrics_snapshot(self) -> Dict[str, object]:
+        """One flat dict of everything this node knows: its own registry
+        (txn.* latencies), the legacy protocol counters (prefixed node.*),
+        and every attached resolver's / exec plane's registry snapshot."""
+        snap = self.metrics.snapshot()
+        for name, v in sorted(self.counters.items()):
+            snap[f"node.{name}"] = v
+        seen = set()
+        if self.command_stores is not None:
+            for store in self.command_stores.all():
+                for obj in (store.deps_resolver,
+                            getattr(store, "exec_plane", None)):
+                    if obj is None or id(obj) in seen:
+                        continue
+                    seen.add(id(obj))
+                    sub = getattr(obj, "snapshot", None)
+                    if sub is not None:
+                        snap.update(sub())
+        return snap
+
+    def emit_metrics_snapshot(self, reason: str = "final") -> None:
+        """Write a one-line JSON metrics snapshot through metrics_sink (the
+        maelstrom runner's stderr logger). No sink: silently skip."""
+        if self.metrics_sink is None:
+            return
+        import json
+        self.metrics_sink("metrics %s node=%s %s" % (
+            reason, self.id, json.dumps(self.metrics_snapshot(),
+                                        sort_keys=True)))
+
     # -- lifecycle -----------------------------------------------------------
     def shutdown(self) -> None:
         """Graceful stop of the device deps pipeline: flush every attached
         resolver's staged (encode-ahead) plans AND in-flight device calls
         for this node, so no enqueued AsyncResult strands once the scheduler
         stops delivering this node's events. Idempotent; a node with no
-        batched resolver is a no-op."""
-        if self.command_stores is None:
-            return
-        drained = set()
-        for store in self.command_stores.all():
-            resolver = store.deps_resolver
-            if resolver is None or id(resolver) in drained:
-                continue
-            drained.add(id(resolver))
-            drain = getattr(resolver, "drain", None)
-            if drain is not None:
-                drain(self)
+        batched resolver is a no-op. Ends by emitting a final metrics
+        snapshot through metrics_sink (when one is installed)."""
+        if self.command_stores is not None:
+            drained = set()
+            for store in self.command_stores.all():
+                resolver = store.deps_resolver
+                if resolver is None or id(resolver) in drained:
+                    continue
+                drained.add(id(resolver))
+                drain = getattr(resolver, "drain", None)
+                if drain is not None:
+                    drain(self)
+        self.emit_metrics_snapshot("shutdown")
 
 
 class _ReliableSend:

@@ -50,11 +50,21 @@ def serve(stdin=None, stdout=None, stderr=None) -> int:
             except json.JSONDecodeError as e:
                 log(f"bad json: {e}")
 
+    # periodic metrics snapshots ride stderr on a wall-clock cadence from
+    # THIS loop (not a scheduler timer, which would keep next_deadline_us
+    # non-None forever and block the EOF exit above)
+    import time as _time
+    metrics_interval_s = 10.0
+    last_snap = _time.monotonic()
+
     while True:
         deadline = node.scheduler.next_deadline_us()
         if eof:
             if deadline is None:
-                return 0  # timers drained: in-flight work is settled
+                # timers drained: in-flight work is settled. Flush the
+                # device pipeline and emit the final metrics snapshot.
+                node.shutdown()
+                return 0
             # finish pending coordinations/timeouts before exiting
             wait = max(0.0, (deadline - node.clock.now_micros()) / 1e6)
             import time as _t
@@ -71,6 +81,10 @@ def serve(stdin=None, stdout=None, stderr=None) -> int:
             else:
                 pump(chunk)
         node.scheduler.run_due()
+        if node.node is not None \
+                and _time.monotonic() - last_snap >= metrics_interval_s:
+            last_snap = _time.monotonic()
+            node.node.emit_metrics_snapshot("periodic")
 
 
 if __name__ == "__main__":

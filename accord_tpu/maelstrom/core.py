@@ -329,7 +329,16 @@ class MaelstromNode:
             device_poll_ms=1.0,
         )
         engine.bind(self.node)
+        # metrics snapshots (periodic + final) ride the stderr logger --
+        # stdout stays protocol-only for Jepsen
+        self.node.metrics_sink = self.log
         self.emit(src, {"type": "init_ok", "in_reply_to": body.get("msg_id")})
+
+    def shutdown(self) -> None:
+        """Drain the device pipeline and emit the final metrics snapshot
+        (Node.shutdown ends with emit_metrics_snapshot)."""
+        if self.node is not None:
+            self.node.shutdown()
 
     # -- the txn workload -----------------------------------------------------
     def _on_txn(self, src: str, body: dict) -> None:

@@ -13,6 +13,8 @@ from typing import Dict, List, Optional
 from accord_tpu import api
 from accord_tpu.local.node import TimeService
 from accord_tpu.maelstrom.core import KEY_DOMAIN, MaelstromNode
+from accord_tpu.obs.metrics import MetricsRegistry
+from accord_tpu.obs.trace import REC
 from accord_tpu.sim.queue import PendingQueue
 from accord_tpu.utils.rng import RandomSource
 
@@ -61,6 +63,11 @@ class Runner:
     def __init__(self, seed: int, num_nodes: int = 3,
                  latency_us: tuple = (500, 5000)):
         self.queue = PendingQueue()
+        # workload stats (maelstrom.* counters) -- bench JSON reads these
+        self.metrics = MetricsRegistry()
+        # node-less flight-recorder sites timestamp from the sim queue so
+        # in-process maelstrom traces stay seed-deterministic
+        REC.clock = lambda q=self.queue: q.now_micros
         self.rng = RandomSource(seed)
         self.latency_us = latency_us
         self.nodes: Dict[str, MaelstromNode] = {}
@@ -155,5 +162,17 @@ class Runner:
             for shorter, longer in zip(observations, observations[1:]):
                 assert longer[:len(shorter)] == shorter, \
                     f"key {key}: {shorter} is not a prefix of {longer}"
-        return {"txn_ok": oks, "errors": errors,
-                "reads_checked": sum(len(v) for v in reads_per_key.values())}
+        m = self.metrics
+        m.counter("maelstrom.txn_ok").inc(oks)
+        m.counter("maelstrom.errors").inc(errors)
+        m.counter("maelstrom.reads_checked").inc(
+            sum(len(v) for v in reads_per_key.values()))
+        return {"txn_ok": m.counter("maelstrom.txn_ok").value,
+                "errors": m.counter("maelstrom.errors").value,
+                "reads_checked": m.counter("maelstrom.reads_checked").value}
+
+    def shutdown(self) -> None:
+        """Drain every node's device pipeline; each emits its final metrics
+        snapshot through the runner's log (MaelstromNode.shutdown)."""
+        for node in self.nodes.values():
+            node.shutdown()

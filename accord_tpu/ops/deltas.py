@@ -20,6 +20,8 @@ from typing import Callable, Sequence
 
 import numpy as np
 
+from accord_tpu.obs.trace import REC
+
 # the warmable row tiers every lane delta chunks to (see kernels.scatter_rows
 # and resolver.warmup)
 LANE_ROW_TIERS = (8, 64)
@@ -49,5 +51,10 @@ def flush_lane(lane, rows: Sequence[int], src: np.ndarray,
         idx[:len(chunk)] = chunk
         data = src[idx]
         on_chunk(idx.nbytes + data.nbytes, m)
+        if REC.enabled:
+            # no node in scope here: the recorder's configured clock (sim
+            # time under the cluster/maelstrom) timestamps the upload
+            REC.instant(0, "deltas", "lane_upload", REC.now_us(),
+                        args={"bytes": idx.nbytes + data.nbytes, "tier": m})
         lane = scatter_rows(lane, jnp.asarray(idx), jnp.asarray(data))
     return lane

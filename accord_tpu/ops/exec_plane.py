@@ -31,6 +31,9 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from accord_tpu.local.status import Status
+from accord_tpu.obs.metrics import (CounterDict, MetricsRegistry, RegCounter,
+                                    RegTimer)
+from accord_tpu.obs.trace import REC, node_pid, node_ts
 from accord_tpu.ops.encoding import TimestampEncoder
 from accord_tpu.primitives.timestamp import Timestamp, TxnId
 from accord_tpu.utils.invariants import Invariants
@@ -43,8 +46,18 @@ class ExecPlane:
 
     GROW = 2
 
+    # bench/diagnostic counters -- registry-backed descriptors (obs/metrics):
+    # legacy attribute reads/writes proxy onto self.metrics unchanged
+    dispatches = RegCounter("exec.dispatches")
+    releases = RegCounter("exec.releases")
+    harvest_stall_s = RegTimer("exec.harvest_stall_s")
+    prefetched = RegCounter("exec.prefetched")
+    upload_bytes = RegCounter("exec.upload_bytes")
+    upload_bytes_full_equiv = RegCounter("exec.upload_bytes_full_equiv")
+
     def __init__(self, store, initial_cap: int = 1024,
                  tick_ms: float = 2.0, device_latency_ms: float = 4.0):
+        self.metrics = MetricsRegistry()
         self.store = store
         self.cap = initial_cap
         self.count = 0
@@ -82,19 +95,15 @@ class ExecPlane:
         # which pops the head (mirrors ops/resolver.py's pipeline)
         self._inflight: deque = deque()
         self._poll_armed = False
-        # bench/diagnostic counters
-        self.dispatches = 0
-        self.releases = 0
-        self.harvest_stall_s = 0.0
-        self.prefetched = 0
-        self.upload_bytes = 0
         # field-granular accounting, mirroring the resolver arenas:
         # upload_bytes == sum of the by-field buckets; full_equiv is what
         # the retired whole-row scheme would have shipped for the same
         # dirty sets (the baseline proving the granular deltas' win)
-        self.upload_bytes_by_field: Dict[str, int] = \
-            {"full": 0, "ts": 0, "flags": 0}
-        self.upload_bytes_full_equiv = 0
+        self.upload_bytes_by_field = CounterDict(
+            self.metrics, "exec.upload_bytes", ("full", "ts", "flags"))
+
+    def snapshot(self) -> dict:
+        return self.metrics.snapshot()
 
     # -- row management ------------------------------------------------------
     def _row(self, txn_id: TxnId) -> int:
@@ -402,6 +411,10 @@ class ExecPlane:
         out = execution_frontier(*self._sync_device())
         out.copy_to_host_async()
         self.dispatches += 1
+        if REC.enabled:
+            node = self.store.node
+            REC.instant(node_pid(node), "exec", "frontier_dispatch",
+                        node_ts(node), args={"rows": self.count})
         return out
 
     def _sync_device(self):
@@ -532,8 +545,15 @@ class ExecCoordinator:
     per-store word spans otherwise. Cuts per-tick launch count on
     many-store nodes from stores-with-work to one."""
 
+    # registry-backed counters (see ExecPlane's descriptor block)
+    dispatches = RegCounter("exec_coord.dispatches")
+    fused_dispatches = RegCounter("exec_coord.fused_dispatches")
+    harvest_stall_s = RegTimer("exec_coord.harvest_stall_s")
+    prefetched = RegCounter("exec_coord.prefetched")
+
     def __init__(self, node, tick_ms: float = 2.0,
                  device_latency_ms: float = 4.0):
+        self.metrics = MetricsRegistry()
         self.node = node
         self.tick_ms = tick_ms
         self.device_latency_ms = device_latency_ms
@@ -542,10 +562,6 @@ class ExecCoordinator:
         # [fused frontier, host copy or None, [(plane, (lo, hi), gen)]]
         self._inflight: deque = deque()
         self._poll_armed = False
-        self.dispatches = 0
-        self.fused_dispatches = 0
-        self.harvest_stall_s = 0.0
-        self.prefetched = 0
 
     def register(self, plane: ExecPlane) -> None:
         plane.coordinator = self
@@ -579,6 +595,11 @@ class ExecCoordinator:
         self.dispatches += 1
         for p in parts:
             p.dispatches += 1
+        if REC.enabled:
+            REC.instant(node_pid(self.node), "exec", "frontier_dispatch",
+                        node_ts(self.node),
+                        args={"stores": len(parts),
+                              "fused": len(parts) > 1})
         self._inflight.append(
             [out, None, [(p, s, p._gen) for p, s in zip(parts, spans)]])
         self.node.scheduler.once(self.device_latency_ms, self._harvest)

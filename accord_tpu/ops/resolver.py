@@ -63,6 +63,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from accord_tpu.local.cfk import CfkStatus
+from accord_tpu.obs.metrics import MetricsRegistry, RegCounter, RegTimer
+from accord_tpu.obs.trace import REC, node_pid, node_ts
 from accord_tpu.ops.encoding import (TimestampEncoder, WITNESS_TABLE,
                                      encode_interval,
                                      encode_key_point_intervals,
@@ -1322,10 +1324,10 @@ class _Call:
     fallback fetches them lazily -- blocking, and counted as readback."""
 
     __slots__ = ("packed", "rpacked", "kpacked", "items", "groups",
-                 "np_packed", "np_rpacked", "np_kpacked", "want")
+                 "np_packed", "np_rpacked", "np_kpacked", "want", "did")
 
     def __init__(self, packed, rpacked, kpacked, items, groups,
-                 want=(True, True, True)):
+                 want=(True, True, True), did=-1):
         self.packed = packed        # fused key-domain result (or None)
         self.rpacked = rpacked      # fused range-arena result
         self.kpacked = kpacked      # fused key-arena hull result
@@ -1337,6 +1339,9 @@ class _Call:
         self.np_packed: Optional[np.ndarray] = None
         self.np_rpacked: Optional[np.ndarray] = None
         self.np_kpacked: Optional[np.ndarray] = None
+        # monotone dispatch id (per resolver): keys this call's device-
+        # window span in the flight recorder (-1: sync path, untraced)
+        self.did = did
 
     def buffers(self):
         """(holder, host attr, device value) triples the async-copy / poll /
@@ -1405,6 +1410,41 @@ class _Plan:
 class BatchDepsResolver(DepsResolver):
     MAX_DISPATCH = 128  # subjects per kernel call (a named, warmable jit tier)
 
+    # bench counters -- descriptors proxying onto self.metrics, so every
+    # legacy `resolver.dispatches` read/write is a registry cell and
+    # `snapshot()` is the single source for bench JSON (obs/metrics.py)
+    dispatches = RegCounter("resolver.dispatches")
+    subjects = RegCounter("resolver.subjects")
+    ticks = RegCounter("resolver.ticks")             # node ticks with items
+    preaccept_s = RegTimer("resolver.preaccept_s")   # host preaccepts
+    encode_s = RegTimer("resolver.encode_s")         # upload-array build
+    dispatch_s = RegTimer("resolver.dispatch_s")     # launch + readback enq
+    harvest_stall_s = RegTimer("resolver.harvest_stall_s")  # blocking xfers
+    decode_s = RegTimer("resolver.decode_s")         # result materialization
+    readback_s = RegTimer("resolver.readback_s")     # device->host transfer
+    materialize_s = RegTimer("resolver.materialize_s")  # decode minus readback
+    host_hidden_s = RegTimer("resolver.host_hidden_s")  # host time overlapped
+    #                                                     with an in-flight call
+    staged_dispatches = RegCounter("resolver.staged_dispatches")
+    padded_dispatches = RegCounter("resolver.padded_dispatches")
+    prefetched = RegCounter("resolver.prefetched")   # poll-drained transfers
+    polls_armed = RegCounter("resolver.polls_armed")
+    stale_harvests = RegCounter("resolver.stale_harvests")  # cross-compaction
+    host_fallbacks = RegCounter("resolver.host_fallbacks")  # unpinned + stale
+    # subjects demoted host-side for unencodable range endpoints (never
+    # hit by integer key domains)
+    range_fallbacks = RegCounter("resolver.range_fallbacks")
+    # finalized-CSR harvest accounting: groups materialized straight from
+    # the compacted device CSR vs groups through the legacy unpackbits
+    # decode (finalize off, or a guard tripped -- the latter also counted
+    # as finalize_fallbacks)
+    finalized_decodes = RegCounter("resolver.finalized_decodes")
+    legacy_decodes = RegCounter("resolver.legacy_decodes")
+    finalize_fallbacks = RegCounter("resolver.finalize_fallbacks")
+    # adaptive staged window: scale adjustments per direction
+    window_shrinks = RegCounter("resolver.window_shrinks")
+    window_widens = RegCounter("resolver.window_widens")
+
     def __init__(self, num_buckets: int = 256, initial_cap: int = 4096,
                  max_dispatch: Optional[int] = None,
                  fuse_cross_store: bool = True,
@@ -1413,6 +1453,10 @@ class BatchDepsResolver(DepsResolver):
                  finalize_on_device: bool = True,
                  adaptive_window: bool = False,
                  kid_cap: int = 4096):
+        # the registry backing every bench counter below (the class-level
+        # RegCounter/RegTimer descriptors write through to it), BEFORE any
+        # counter touch
+        self.metrics = MetricsRegistry()
         # the range kernel's covered-bucket contraction reduces intervals
         # modulo the bucket count with int32 arithmetic; that wrap is exact
         # only when num_buckets divides 2^32
@@ -1474,41 +1518,6 @@ class BatchDepsResolver(DepsResolver):
         # (the pool grows alongside arenas that outgrow initial_cap)
         self._pad_key: Dict[int, tuple] = {}
         self._pad_range: Dict[int, tuple] = {}
-        # bench counters
-        self.dispatches = 0
-        self.subjects = 0
-        self.ticks = 0               # node ticks that produced any items
-        self.preaccept_s = 0.0       # host preaccept transitions (stage_host)
-        self.encode_s = 0.0          # host-side upload-array build + enqueue
-        self.dispatch_s = 0.0        # kernel launch + readback enqueue
-        self.harvest_stall_s = 0.0   # blocking on the async transfer
-        self.decode_s = 0.0          # host-side result materialization
-        self.readback_s = 0.0        # device->host transfer time (stalls +
-        #                              lazy fallback fetches; prefetched
-        #                              transfers cost ~0 here)
-        self.materialize_s = 0.0     # decode_s minus readback inside decode
-        self.host_hidden_s = 0.0     # host phase time spent while >=1 call
-        #                              was in flight (overlapped = hidden)
-        self.staged_dispatches = 0   # launches that came off the staged list
-        self.padded_dispatches = 0   # fused call sides topped up to
-        #                              pad_store_tiers with empty blocks
-        self.prefetched = 0          # harvests whose transfer the poll drained
-        self.polls_armed = 0         # readiness polls armed (device_poll_ms)
-        self.stale_harvests = 0      # calls translated across a compaction
-        self.host_fallbacks = 0      # stale calls with no pinned snapshot
-        # subjects demoted host-side for unencodable range endpoints (never
-        # hit by integer key domains)
-        self.range_fallbacks = 0
-        # finalized-CSR harvest accounting: groups materialized straight
-        # from the compacted device CSR vs groups that ran the legacy
-        # unpackbits decode (finalize off, or a guard tripped -- the latter
-        # also counted as finalize_fallbacks)
-        self.finalized_decodes = 0
-        self.legacy_decodes = 0
-        self.finalize_fallbacks = 0
-        # adaptive staged window: scale adjustments per direction
-        self.window_shrinks = 0
-        self.window_widens = 0
         # initial _RangeArena capacity (the sharded resolver widens it to
         # keep rcap % (32*data) == 0)
         self.range_cap = 64
@@ -1549,6 +1558,17 @@ class BatchDepsResolver(DepsResolver):
         return sum(a.upload_bytes_full_equiv
                    + a.ranges.upload_bytes_full_equiv
                    for a in self._arenas.values())
+
+    def snapshot(self) -> dict:
+        """Flat registry snapshot plus the arena-computed gauges -- the
+        single source for bench JSON and metrics dumps."""
+        snap = self.metrics.snapshot()
+        snap["resolver.host_hidden_pct"] = round(self.host_hidden_pct, 3)
+        snap["resolver.upload_bytes"] = self.upload_bytes
+        snap["resolver.upload_bytes_full_equiv"] = self.upload_bytes_full_equiv
+        for k, v in self.upload_bytes_by_field.items():
+            snap[f"resolver.upload_bytes.{k}"] = v
+        return snap
 
     # -- arena plumbing -------------------------------------------------------
     def _arena(self, store) -> _StoreArena:
@@ -1683,12 +1703,22 @@ class BatchDepsResolver(DepsResolver):
         # array build for the NEXT tick's launch. Registrations land in the
         # arena before _encode_plan cuts each plan's field-granular delta
         # upload, so batchmates still witness each other.
+        ts = node_ts(node) if REC.enabled else 0
         t0 = _time.perf_counter()
         items = self._drain_and_preaccept(node)
         self._adapt(node, len(items))
         plans = [self._stage(node, sub) for sub in self._slices(items)]
-        if self._inflight.get(id(node)):
-            self.host_hidden_s += _time.perf_counter() - t0
+        dt = _time.perf_counter() - t0
+        hidden = bool(self._inflight.get(id(node)))
+        if hidden:
+            self.host_hidden_s += dt
+        if REC.enabled:
+            # dur mirrors the exact host_hidden_s contribution above, so a
+            # trace-side hidden-share computation reconciles with the
+            # registry's host_hidden_pct (asserted by bench_e2e --trace)
+            REC.complete(node_pid(node), "stage_host", "stage_host", ts,
+                         dur=round(dt * 1e6, 3),
+                         args={"hidden": hidden, "items": len(items)})
         if plans:
             self._staged[id(node)] = plans
             self._arm_tick(node)
@@ -1718,7 +1748,12 @@ class BatchDepsResolver(DepsResolver):
                 continue
             items.append(_Item(store, t, store.owned(p.keys),
                                store.command(t).execute_at, out, outcome))
-        self.preaccept_s += _time.perf_counter() - t0
+        dt = _time.perf_counter() - t0
+        self.preaccept_s += dt
+        if REC.enabled:
+            REC.complete(node_pid(node), "stage_host", "preaccept",
+                         node_ts(node), dur=round(dt * 1e6, 3),
+                         args={"batch": len(pa)})
         for (store, t, ks, before, out) in dq:
             items.append(_Item(store, t, store.owned(ks), before, out))
         if items:
@@ -2643,7 +2678,13 @@ class BatchDepsResolver(DepsResolver):
             return _Plan(items, groups, empty=True)
         t0 = _time.perf_counter()
         plan = self._encode_plan(groups, items)
-        self.encode_s += _time.perf_counter() - t0
+        dt = _time.perf_counter() - t0
+        self.encode_s += dt
+        if REC.enabled:
+            REC.complete(node_pid(node), "stage_host", "encode",
+                         node_ts(node), dur=round(dt * 1e6, 3),
+                         args={"subjects": len(items),
+                               "stores": len(groups)})
         return plan
 
     def _launch(self, node, plan: _Plan, staged: bool = False) -> None:
@@ -2651,20 +2692,38 @@ class BatchDepsResolver(DepsResolver):
         already taken at plan time, matched by unpin_gen in _harvest),
         enqueue the async readback, and schedule the harvest."""
         import time as _time
+        did = self.dispatches  # monotone per resolver: the trace span key
         if plan.empty:
-            call = _Call(None, None, None, plan.items, plan.groups)
+            call = _Call(None, None, None, plan.items, plan.groups, did=did)
         else:
             t0 = _time.perf_counter()
             packed, rpacked, kpacked = self._run_plan(plan)
             call = _Call(packed, rpacked, kpacked, plan.items, plan.groups,
-                         plan.want)
+                         plan.want, did=did)
             for _, _, dev in call.buffers():
                 _dev_copy_async(dev)
-            self.dispatch_s += _time.perf_counter() - t0
+            dt = _time.perf_counter() - t0
+            self.dispatch_s += dt
+            if REC.enabled:
+                REC.complete(node_pid(node), "device", "launch",
+                             node_ts(node), dur=round(dt * 1e6, 3),
+                             args={"did": did})
         self.dispatches += 1
         if staged:
             self.staged_dispatches += 1
         self.subjects += len(plan.items)
+        if REC.enabled:
+            ts = node_ts(node)
+            pid = node_pid(node)
+            REC.async_begin(pid, "device", "window", f"d{did}", ts,
+                            local=True,
+                            args={"subjects": len(plan.items),
+                                  "staged": staged, "empty": plan.empty})
+            # flow steps land each subject txn on the device track, linking
+            # coordinator -> replica -> dispatch in the Perfetto view
+            for item in plan.items:
+                REC.txn_step(pid, item.txn_id, "dispatch", ts,
+                             args={"did": did})
         self._inflight.setdefault(id(node), deque()).append(call)
         delay = getattr(node, "device_latency_ms", 4.0)
         node.scheduler.once(delay, lambda: self._harvest(node))
@@ -2733,6 +2792,7 @@ class BatchDepsResolver(DepsResolver):
         if not q:
             return  # defensive: every dispatch schedules exactly one harvest
         call = q.popleft()
+        stalled = False
         if call.has_device:
             t0 = _time.perf_counter()
             stalled = call.fetch()
@@ -2742,6 +2802,10 @@ class BatchDepsResolver(DepsResolver):
                 self.harvest_stall_s += ft
             else:
                 self.prefetched += 1
+        if REC.enabled:
+            REC.async_end(node_pid(node), "device", "window",
+                          f"d{call.did}", node_ts(node), local=True,
+                          args={"stalled": stalled})
         t0 = _time.perf_counter()
         if any((g.pk is not None and g.gen != g.arena.gen)
                or (g.rp is not None and g.rgen != g.arena.ranges.gen)
@@ -2763,6 +2827,10 @@ class BatchDepsResolver(DepsResolver):
             # calls still in flight behind this one: stage_decode ran
             # inside their device window
             self.host_hidden_s += dt
+        if REC.enabled:
+            REC.complete(node_pid(node), "device", "decode", node_ts(node),
+                         dur=round(dt * 1e6, 3),
+                         args={"hidden": bool(q), "did": call.did})
         for item, deps in zip(call.items, results):
             if item.outcome is not None:
                 item.out.try_set_success((item.outcome, item.before, deps))

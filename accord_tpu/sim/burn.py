@@ -43,6 +43,10 @@ class BurnReport:
         # cluster-wide protocol event counts (sum of node.counters): probes
         # sent, informs exchanged -- the home-shard gossip tests compare them
         self.counters: Dict[str, int] = {}
+        # cluster-wide MetricsRegistry: every node's registry merged at the
+        # end of the run (txn latency histograms, resolver counters); bench
+        # JSON reads its snapshot()
+        self.registry = None
 
     def as_dict(self) -> dict:
         return {"acked": self.acked, "failed": self.failed, "lost": self.lost,
@@ -299,6 +303,10 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
     cluster.check_no_failures()
     verifier.check_final_state(cluster.converged_key_lists())
     report.counters = cluster.total_counters()
+    from accord_tpu.obs.metrics import MetricsRegistry
+    report.registry = MetricsRegistry()
+    for node in cluster.nodes.values():
+        report.registry.merge_from(node.metrics)
     return report
 
 

@@ -202,6 +202,13 @@ class Cluster:
         self.config = config or ClusterConfig()
         self.rng = RandomSource(seed)
         self.queue = PendingQueue()
+        # point the flight recorder's fallback clock at deterministic sim
+        # time: node-less record sites (delta uploads) then timestamp from
+        # the same clock as everything else and same-seed traces stay
+        # byte-identical (last cluster constructed wins; recording is
+        # run-scoped)
+        from accord_tpu.obs.trace import REC
+        REC.clock = lambda q=self.queue: q.now_micros
         self.network = SimNetwork(self.queue, self.rng.fork(),
                                   timeout_ms=self.config.timeout_ms,
                                   serialize=self.config.serialize)

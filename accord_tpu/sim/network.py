@@ -14,6 +14,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 from accord_tpu.api import MessageSink
 from accord_tpu.messages.base import Callback, Timeout
+from accord_tpu.obs.trace import REC
 from accord_tpu.primitives.timestamp import NodeId
 from accord_tpu.sim.queue import PendingQueue
 from accord_tpu.sim import wire
@@ -102,6 +103,9 @@ class SimNetwork:
         if src in self.dead:
             return  # a crashed incarnation's residual sends are muted
         self.stats["sent"] += 1
+        if REC.enabled:
+            REC.instant(src, "net", "send", self.queue.now_micros,
+                        args={"to": dst, "msg": type(request).__name__})
         msg_id = next(self._msg_ids)
         if callback is not None:
             timeout_handle = self.queue.add(
@@ -125,6 +129,10 @@ class SimNetwork:
                 self.stats["dropped"] += 1
                 return
             self._count("delivered")
+            if REC.enabled:
+                REC.instant(dst, "net", "deliver", self.queue.now_micros,
+                            args={"from": src,
+                                  "msg": type(request).__name__})
             if self.on_deliver is not None \
                     and getattr(request, "has_side_effects", True):
                 self.on_deliver(dst, src,

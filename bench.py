@@ -64,6 +64,74 @@ DAG_N = 100_000
 DAG_LEVELS = 192
 LARGE_REPLAY_OPS = 100_000  # BASELINE "YCSB-T-style large replay"
 
+# --trace <base>: every top-level leg dumps a Perfetto-loadable trace to
+# <base>.<leg>.json; bench_e2e additionally scopes one to its first device
+# attempt and cross-checks the trace's hidden-overlap share against the
+# registry's host_hidden_pct (set by main(), None = tracing off)
+TRACE_BASE = None
+TRACE_CAPACITY = 1 << 20
+
+
+def _trace_start():
+    from accord_tpu.obs.trace import REC
+    REC.clear()
+    REC.configure(capacity=TRACE_CAPACITY, wall=True)
+    REC.enabled = True
+
+
+def _trace_dump(leg: str) -> str:
+    from accord_tpu.obs import export
+    from accord_tpu.obs.trace import REC
+    REC.enabled = False
+    path = f"{TRACE_BASE}.{leg}.json"
+    export.write_trace(path, REC.events())
+    REC.clear()
+    return path
+
+
+def _traced(leg: str, fn, *args, **kwargs):
+    """Run one bench leg with the flight recorder on, dumping its trace
+    (no-op passthrough when --trace was not given)."""
+    if TRACE_BASE is None:
+        return fn(*args, **kwargs)
+    _trace_start()
+    try:
+        return fn(*args, **kwargs)
+    finally:
+        _trace_dump(leg)
+
+
+def _reconcile_trace(events, dropped: int, registry_pct: float,
+                     path: str) -> dict:
+    """Cross-check the traced device leg against the registry: the X spans'
+    wall durations are the SAME perf_counter deltas the resolver timers
+    accumulate, so the trace-derived hidden-overlap share must land within
+    one percentage point of the registry's host_hidden_pct."""
+    if dropped:
+        raise AssertionError(
+            f"flight recorder dropped {dropped} events during the traced "
+            f"e2e leg; raise TRACE_CAPACITY")
+    denom = 0.0
+    hidden = 0.0
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        dur = ev.get("dur", 0.0)
+        name = ev["name"]
+        if name in ("preaccept", "encode", "launch", "decode"):
+            denom += dur
+        if name in ("stage_host", "decode") \
+                and ev.get("args", {}).get("hidden"):
+            hidden += dur
+    trace_pct = 100.0 * hidden / denom if denom else 0.0
+    if abs(trace_pct - registry_pct) > 1.0:
+        raise AssertionError(
+            f"trace/registry hidden-overlap mismatch: trace says "
+            f"{trace_pct:.2f}%, registry says {registry_pct:.2f}%")
+    return {"path": path, "events": len(events),
+            "hidden_pct": round(trace_pct, 1),
+            "registry_hidden_pct": round(registry_pct, 1)}
+
 
 # ---------------------------------------------------------------------------
 # 1. pipeline: 10k in-flight txns over 1k keys, real store
@@ -341,9 +409,11 @@ def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool,
     from accord_tpu.sim.burn import run_burn
     from accord_tpu.sim.cluster import ClusterConfig
 
+    from accord_tpu.obs.metrics import MetricsRegistry
+
     resolvers = []
     factory = None
-    samples = []
+    host_reg = MetricsRegistry()  # host leg: per-scan latency histogram
     orig = None
     cache0 = None
     if device:
@@ -365,7 +435,9 @@ def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool,
         def timed(self, txn_id, seekables, before):
             t0 = time.perf_counter()
             out = orig(self, txn_id, seekables, before)
-            samples.append(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            host_reg.timer("host.calc_deps_s").add(dt)
+            host_reg.histogram("host.calc_deps_us").observe(dt * 1e6)
             return out
 
         store_mod.CommandStore.host_calculate_deps = timed
@@ -411,8 +483,19 @@ def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool,
                 f"jit tiers compiled inside the e2e burn: {drift} "
                 "(warmup store_tiers coverage is stale)")
         finalize_compiles = sum(cache1[k] - cache0[k] for k in data_tiered)
-        dispatches = sum(r.dispatches for r in resolvers)
-        ticks = sum(r.ticks for r in resolvers)
+        # fold every resolver's registry into one: the merged snapshot is
+        # the single source for the stats below (the legacy attribute reads
+        # are descriptor views over these same cells)
+        agg = MetricsRegistry()
+        for r in resolvers:
+            agg.merge_from(r.metrics)
+        snap = agg.snapshot()
+
+        def g(name, default=0):
+            return snap.get("resolver." + name, default)
+
+        dispatches = g("dispatches")
+        ticks = g("ticks")
         # fused cross-store dispatch engaged: a per-store drain would pay
         # stores_per_node dispatches per tick
         if ticks and dispatches >= cfg.stores_per_node * ticks:
@@ -422,7 +505,7 @@ def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool,
         # finalized-CSR harvest engaged on the burn's device leg (legacy
         # decodes still legitimately run for groups caught by a mid-flight
         # truncation/compaction -- those are counted, not forbidden)
-        if dispatches and sum(r.finalized_decodes for r in resolvers) == 0:
+        if dispatches and g("finalized_decodes") == 0:
             raise AssertionError(
                 "finalized-CSR harvest never engaged in the e2e burn")
         ub = sum(r.upload_bytes for r in resolvers)
@@ -435,7 +518,7 @@ def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool,
                 f"{ub} >= {ube}")
         # staged tick pipeline engaged (overlap legs): the launches must
         # come off the encode-ahead lists, not the serial fallback
-        staged = sum(r.staged_dispatches for r in resolvers)
+        staged = g("staged_dispatches")
         if overlap and dispatches and staged == 0:
             raise AssertionError(
                 "staged pipeline disengaged in the e2e burn "
@@ -443,9 +526,9 @@ def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool,
         if not overlap and staged:
             raise AssertionError(
                 f"serial leg took {staged} staged launches")
-        phases = sum(r.preaccept_s + r.encode_s + r.dispatch_s + r.decode_s
-                     for r in resolvers)
-        hidden = sum(r.host_hidden_s for r in resolvers)
+        phases = (g("preaccept_s", 0.0) + g("encode_s", 0.0)
+                  + g("dispatch_s", 0.0) + g("decode_s", 0.0))
+        hidden = g("host_hidden_s", 0.0)
         by_field = {}
         for r in resolvers:
             for k, v in r.upload_bytes_by_field.items():
@@ -456,37 +539,45 @@ def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool,
             "staged_dispatches": staged,
             "ticks": ticks,
             "dispatches_per_tick": round(dispatches / max(ticks, 1), 3),
-            "subjects": sum(r.subjects for r in resolvers),
-            "preaccept_s": round(sum(r.preaccept_s for r in resolvers), 2),
-            "encode_s": round(sum(r.encode_s for r in resolvers), 2),
-            "dispatch_s": round(sum(r.dispatch_s for r in resolvers), 2),
+            "subjects": g("subjects"),
+            "preaccept_s": round(g("preaccept_s", 0.0), 2),
+            "encode_s": round(g("encode_s", 0.0), 2),
+            "dispatch_s": round(g("dispatch_s", 0.0), 2),
             "host_hidden_s": round(hidden, 2),
             "host_hidden_pct": round(100.0 * hidden / phases, 1)
             if phases else 0.0,
-            "harvest_stall_s": round(sum(r.harvest_stall_s for r in resolvers), 2),
-            "decode_s": round(sum(r.decode_s for r in resolvers), 2),
-            "readback_s": round(sum(r.readback_s for r in resolvers), 2),
-            "materialize_s": round(sum(r.materialize_s for r in resolvers), 2),
-            "finalized_decodes": sum(r.finalized_decodes for r in resolvers),
-            "legacy_decodes": sum(r.legacy_decodes for r in resolvers),
-            "finalize_fallbacks": sum(r.finalize_fallbacks
-                                      for r in resolvers),
+            "harvest_stall_s": round(g("harvest_stall_s", 0.0), 2),
+            "decode_s": round(g("decode_s", 0.0), 2),
+            "readback_s": round(g("readback_s", 0.0), 2),
+            "materialize_s": round(g("materialize_s", 0.0), 2),
+            "finalized_decodes": g("finalized_decodes"),
+            "legacy_decodes": g("legacy_decodes"),
+            "finalize_fallbacks": g("finalize_fallbacks"),
             "finalize_tier_compiles": finalize_compiles,
-            "prefetched": sum(r.prefetched for r in resolvers),
-            "stale_harvests": sum(r.stale_harvests for r in resolvers),
-            "host_fallbacks": sum(r.host_fallbacks for r in resolvers),
-            "range_fallbacks": sum(r.range_fallbacks for r in resolvers),
+            "prefetched": g("prefetched"),
+            "stale_harvests": g("stale_harvests"),
+            "host_fallbacks": g("host_fallbacks"),
+            "range_fallbacks": g("range_fallbacks"),
             "upload_bytes": ub,
             "upload_bytes_by_field": by_field,
             "upload_bytes_full_equiv": ube,
         }
     else:
+        scan = host_reg.histogram("host.calc_deps_us").snapshot()
         stats = {
-            "resolve_calls": len(samples),
-            "resolve_total_s": round(sum(samples), 2),
-            "mean_scan_us": round(float(np.mean(samples)) * 1e6, 1)
-            if samples else 0.0,
+            "resolve_calls": scan["count"],
+            "resolve_total_s": round(
+                host_reg.timer("host.calc_deps_s").total, 2),
+            "mean_scan_us": round(scan["mean"], 1),
+            "scan_us": scan,
         }
+    # sim-time txn lifecycle latencies, merged across the burn's nodes
+    # (burn.py folds every node.metrics into report.registry)
+    txn = report.registry.snapshot() if report.registry is not None else {}
+    stats["txn_latency_us"] = {
+        "commit": txn.get("txn.commit_latency_us"),
+        "apply": txn.get("txn.apply_latency_us"),
+    }
     return wall, report, stats
 
 
@@ -494,8 +585,22 @@ def bench_e2e(quick: bool):
     ops, concurrency = (200, 512) if quick else (800, 1024)
     host_wall, host_rep, host_stats = bench_e2e_leg(9, ops, concurrency, False)
     attempts = []
-    for _ in range(1 if quick else 2):
-        attempts.append(bench_e2e_leg(9, ops, concurrency, True))
+    for i in range(1 if quick else 2):
+        if i == 0 and TRACE_BASE is not None:
+            # trace the first device attempt and reconcile the trace's
+            # hidden-overlap share against the registry's host_hidden_pct
+            from accord_tpu.obs.trace import REC
+            _trace_start()
+            attempt = bench_e2e_leg(9, ops, concurrency, True)
+            REC.enabled = False
+            events = REC.events()
+            dropped = REC.dropped
+            path = _trace_dump("e2e_device")
+            attempt[2]["trace"] = _reconcile_trace(
+                events, dropped, attempt[2]["host_hidden_pct"], path)
+            attempts.append(attempt)
+        else:
+            attempts.append(bench_e2e_leg(9, ops, concurrency, True))
     dev_wall, dev_rep, dev_stats = min(attempts, key=lambda a: a[0])
     dev_stats["attempt_walls_s"] = [round(a[0], 1) for a in attempts]
     # the serial-tick baseline (overlap_host=False): same workload, same
@@ -848,10 +953,55 @@ def bench_maelstrom(quick: bool):
     }
 
 
+# ---------------------------------------------------------------------------
+# 5. obs overhead: the disabled flight recorder must cost ~nothing
+# ---------------------------------------------------------------------------
+
+def bench_obs_overhead():
+    """The overhead gate: every hot path in the stack carries recorder
+    calls compiled in, so a DISABLED call must stay a single attribute
+    check -- measured here and asserted under a generous noise ceiling
+    (an enabled-call figure rides along for scale)."""
+    import timeit
+
+    from accord_tpu.obs.trace import REC
+
+    assert not REC.enabled, "recorder left enabled by an earlier leg"
+    n = 200_000
+    stmt = lambda: REC.instant(0, "bench", "x", 0)  # noqa: E731
+    disabled_s = timeit.timeit(stmt, number=n)
+    saved_len = REC._buf.maxlen
+    REC.configure(capacity=1 << 12)
+    REC.enabled = True
+    try:
+        enabled_s = timeit.timeit(stmt, number=n)
+    finally:
+        REC.enabled = False
+        REC.clear()
+        REC.configure(capacity=saved_len)
+    disabled_ns = disabled_s / n * 1e9
+    gate_ns = 1500.0  # interpreter-noise ceiling; a real regression is 10x+
+    if disabled_ns > gate_ns:
+        raise AssertionError(
+            f"disabled flight-recorder call costs {disabled_ns:.0f}ns "
+            f"(gate {gate_ns:.0f}ns): the disabled path stopped being a "
+            f"single attribute check")
+    return {
+        "calls": n,
+        "disabled_ns_per_call": round(disabled_ns, 1),
+        "enabled_ns_per_call": round(enabled_s / n * 1e9, 1),
+        "gate_ns": gate_ns,
+    }
+
+
 def main(argv=None) -> int:
+    global TRACE_BASE
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--trace", metavar="PATH", default=None,
+                    help="dump a Perfetto trace per leg to PATH.<leg>.json")
     args = ap.parse_args(argv)
+    TRACE_BASE = args.trace
     try:
         import jax
         device = jax.devices()[0].platform
@@ -898,13 +1048,16 @@ def main(argv=None) -> int:
                    out_tiers=outs, range_out_tiers=())
         warm_s = time.perf_counter() - t0
 
-        pipeline = bench_pipeline(args.quick)
-        dag = bench_dag(args.quick)
-        maelstrom = bench_maelstrom(args.quick)
+        obs_overhead = bench_obs_overhead()
+        pipeline = _traced("pipeline", bench_pipeline, args.quick)
+        dag = _traced("dag", bench_dag, args.quick)
+        maelstrom = _traced("maelstrom", bench_maelstrom, args.quick)
+        # bench_e2e scopes its own trace to the first device attempt (the
+        # whole-leg wrapper would mix three burns into one stream)
         e2e = bench_e2e(args.quick)
-        range_mix = bench_range_mix(args.quick)
-        pad_tiers = bench_pad_tiers(args.quick)
-        exec_plane = bench_exec_plane(args.quick)
+        range_mix = _traced("range_mix", bench_range_mix, args.quick)
+        pad_tiers = _traced("pad_tiers", bench_pad_tiers, args.quick)
+        exec_plane = _traced("exec_plane", bench_exec_plane, args.quick)
 
         print(json.dumps({
             "metric": "preaccept_deps_block_us_at_10k_inflight",
@@ -921,6 +1074,7 @@ def main(argv=None) -> int:
                 "range_mix": range_mix,
                 "pad_store_tiers": pad_tiers,
                 "exec_plane": exec_plane,
+                "obs_overhead": obs_overhead,
             },
         }))
         return 0
