@@ -315,6 +315,10 @@ GLOSSARY: Dict[str, str] = {
     "resolver.finalized_decodes": "groups decoded from the device CSR",
     "resolver.legacy_decodes": "groups through the legacy unpackbits decode",
     "resolver.finalize_fallbacks": "finalize guards tripped mid-flight",
+    "resolver.outcap_tier_switches": "finalize out-cap tier ladder moves",
+    "resolver.bound_readback_s": "device dep-bound scalar readback wall seconds",
+    "resolver.range_subject_device_decodes": "range subjects decoded from the device stab",
+    "resolver.shard_merge_s": "sharded finalize launch + fragment-merge wall seconds",
     "resolver.window_shrinks": "adaptive window scale-down adjustments",
     "resolver.window_widens": "adaptive window scale-up adjustments",
     # -- resolver computed gauges (folded into resolver.snapshot()) ----------

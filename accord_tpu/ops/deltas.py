@@ -29,7 +29,8 @@ LANE_ROW_TIERS = (8, 64)
 
 def lane_row_tier(n: int) -> int:
     """Smallest warmed row tier holding `n` rows (n <= 64 by chunking)."""
-    return LANE_ROW_TIERS[0] if n <= LANE_ROW_TIERS[0] else LANE_ROW_TIERS[1]
+    from accord_tpu.ops.tiers import snap
+    return snap(n, LANE_ROW_TIERS, LANE_ROW_TIERS[-1])
 
 
 def flush_lane(lane, rows: Sequence[int], src: np.ndarray,

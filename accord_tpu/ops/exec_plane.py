@@ -422,7 +422,8 @@ class ExecPlane:
         tuple (adj, exec_ts, applied, pending, awaits_all) -- the shared
         front half of the solo dispatch and the coordinator's fused one."""
         import jax.numpy as jnp
-        from accord_tpu.ops.deltas import flush_lane, lane_row_tier
+        from accord_tpu.ops.deltas import (LANE_ROW_TIERS, flush_lane,
+                                           lane_row_tier)
         from accord_tpu.ops.kernels import exec_scatter
         if self._device is None:
             # the device adjacency lives UNPACKED (bool[cap, cap]); build it
@@ -442,8 +443,9 @@ class ExecPlane:
             self._dirty_ts -= self._dirty_full
             self._dirty_flags -= self._dirty_full
             full = sorted(self._dirty_full)
-            for lo in range(0, len(full), 64):
-                chunk = full[lo:lo + 64]
+            step = LANE_ROW_TIERS[-1]
+            for lo in range(0, len(full), step):
+                chunk = full[lo:lo + step]
                 # pad to the shared 8/64 row tiers by repeating the first
                 # row (duplicate scatter indexes write identical data), so
                 # dirty-count drift never mints a new compiled shape
@@ -468,9 +470,10 @@ class ExecPlane:
             # all-lanes baseline FIRST, over the union of granular rows
             # chunked exactly like the whole-row scheme would have
             union = sorted(self._dirty_ts | self._dirty_flags)
-            for lo in range(0, len(union), 64):
+            step = LANE_ROW_TIERS[-1]
+            for lo in range(0, len(union), step):
                 self.upload_bytes_full_equiv += self._full_row_bytes(
-                    lane_row_tier(len(union[lo:lo + 64])))
+                    lane_row_tier(len(union[lo:lo + step])))
             d = list(self._device)
 
             def acct(field):

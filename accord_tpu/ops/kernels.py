@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from accord_tpu.ops.tiers import snap
+
 
 def _lex_before(a, b):
     """a < b lexicographically over 3 int32 lanes; a: [..., 3], b: [..., 3]
@@ -570,19 +572,25 @@ def finalize_csr(packed, word_off, kid_rows, slot_subj, slot_kid,
                                itself)
     act_ts:    i32[cap, 3]     the arena's txn-id lanes; gathered through the
                                compacted rows so RESULTS ARE TXN IDS
-    -> (indptr i32[S+1], dep_rows i32[out_cap], dep_ts i32[out_cap, 3]);
+    -> (indptr i32[S+1], dep_rows i32[out_cap], dep_ts i32[out_cap, 3],
+        bound i32 scalar);
        dep order within a slot is ascending arena row; indptr[-1] > out_cap
-       signals overflow (callers size out_cap from the exact host-side
-       popcount bound, so this only trips on a stale bound).
+       signals overflow. `bound` is the segmented reduction over the slots'
+       kid-table row masks -- exactly the host popcount bound
+       (sum of key_pop over the dispatch's slot keys) -- read back with the
+       result so the NEXT dispatch's out_cap tier needs no host O(keys)
+       pass (resolver's OutCapTiers policy).
     """
     b = packed.shape[0]
     kc, w = kid_rows.shape
     blk = jax.lax.dynamic_slice_in_dim(packed, word_off, w, axis=1)
     ok = (slot_subj >= 0) & (slot_subj < b) & (slot_kid >= 0) & (slot_kid < kc)
+    kid_m = kid_rows[jnp.clip(slot_kid, 0, kc - 1)]
+    bound = jnp.sum(jnp.where(
+        ok, jnp.sum(_popcount_u32(kid_m), axis=1, dtype=jnp.int32), 0),
+        dtype=jnp.int32)
     so = jnp.clip(slot_subj, 0, b - 1)
-    m = jnp.where(ok[:, None],
-                  blk[so] & kid_rows[jnp.clip(slot_kid, 0, kc - 1)],
-                  jnp.uint32(0))
+    m = jnp.where(ok[:, None], blk[so] & kid_m, jnp.uint32(0))
     r = subj_row[so]
     widx = jnp.arange(w, dtype=jnp.int32)
     selfbit = jnp.where(
@@ -592,7 +600,7 @@ def finalize_csr(packed, word_off, kid_rows, slot_subj, slot_kid,
     m = m & ~selfbit
     indptr, dep_rows = _packed_segment_compact(m, out_cap)
     dep_ts = act_ts[dep_rows]
-    return indptr, dep_rows, dep_ts
+    return indptr, dep_rows, dep_ts, bound
 
 
 @functools.partial(jax.jit, static_argnames=("out_cap",))
@@ -600,14 +608,17 @@ def range_finalize_csr(iv_of, iv_start, iv_end, ent_ok,
                        subj_before, subj_kinds,
                        r_start, r_end, r_ts, r_kinds, r_valid,
                        witness_table, out_cap: int):
-    """Device-side finalization of KEY-subject range deps: stab the REAL
-    interval endpoint lanes per CSR entry (no covered-bucket hull, no iv_of
-    contraction), so each entry -- a key subject's point interval [k, k+1) --
-    gets its own exact hit segment and the host re-filter against
-    store.range_txns retires. The witness/before/valid masks gather through
-    iv_of, matching range_deps_resolve; `ent_ok` gates which entries finalize
-    (key-subject entries of the targeted store; range subjects keep the
-    candidate path for host-side Range attribution).
+    """Device-side finalization of range-arena deps: stab the REAL interval
+    endpoint lanes per CSR entry (no covered-bucket hull, no iv_of
+    contraction), so each entry gets its own exact hit segment. Entries are
+    either a key subject's point interval [k, k+1) (the key-subject
+    range-deps lane) or ONE PIECE of a range subject's owned interval set
+    (multi-piece subjects contribute one segment lane per piece; the host
+    attribution walk unions the per-piece hits, which is idempotent) -- so
+    the host re-filter against store.range_txns retires for BOTH subject
+    kinds. The witness/before/valid masks gather through iv_of, matching
+    range_deps_resolve; `ent_ok` gates which entries finalize (entries of
+    the targeted store).
 
     -> (indptr i32[NV+1], dep_rows i32[out_cap], dep_ts i32[out_cap, 3]);
        dep_ts carries the range arena's txn-id lanes so results are txn ids.
@@ -693,10 +704,7 @@ def pad_to(x: np.ndarray, size: int, axis: int = 0) -> np.ndarray:
 
 def bucket_size(n: int, minimum: int = 8) -> int:
     """Next power-of-two bucket >= n (>= minimum), so jit caches stay warm."""
-    size = minimum
-    while size < n:
-        size *= 2
-    return size
+    return snap(n, (), minimum)
 
 
 # The deps-resolver subject-batch padding ladder. Deliberately few named
@@ -710,10 +718,7 @@ SUBJECT_TIERS = (8, 64, 128)
 
 def subject_tier(n: int) -> int:
     """Padded subject-batch size for a dispatch of n subjects."""
-    for tier in SUBJECT_TIERS:
-        if n <= tier:
-            return tier
-    return bucket_size(n, 256)
+    return snap(n, SUBJECT_TIERS, 256)
 
 
 # CSR flat-entry padding ladders. The subject CSR (one entry per owned key /
@@ -727,35 +732,29 @@ SCATTER_NNZ_TIERS = (64, 512)
 
 def nnz_tier(n: int) -> int:
     """Padded CSR entry count for a dispatch carrying n subject entries."""
-    for tier in NNZ_TIERS:
-        if n <= tier:
-            return tier
-    return bucket_size(n, 4096)
+    return snap(n, NNZ_TIERS, 4096)
 
 
 def scatter_nnz_tier(n: int) -> int:
     """Padded CSR entry count for an arena-scatter chunk of n key entries."""
-    for tier in SCATTER_NNZ_TIERS:
-        if n <= tier:
-            return tier
-    return bucket_size(n, 1024)
+    return snap(n, SCATTER_NNZ_TIERS, 1024)
 
 
-# Finalized-CSR output padding ladder: the compaction kernels' out_cap is
-# sized from the exact host-side popcount bound per dispatch (sum of the
-# subject keys' live-row counts), then padded to a tier so the jit cache is
-# keyed on padded nnz like the subject CSR tiers. Contended dispatches land
-# on the big tiers; warmup() covers the ladder so tier switches mid-replay
-# never recompile.
+# Finalized-CSR output padding ladder. The compaction kernels' out_cap tier
+# is PINNED by the resolver's OutCapTiers hysteresis policy (ops.tiers),
+# fed by the device-computed bound each finalize call reads back -- grow
+# immediately, shrink only after several consecutive quiet dispatches -- so
+# the picked tier is not data-dependent dispatch to dispatch and the bench's
+# zero-recompile assertion covers the finalize kernels without exemption.
+# (With device_out_bound disabled the resolver sizes from the exact host
+# popcount bound instead: the differential baseline.)
 OUT_TIERS = (256, 2048, 16384)
+OUT_TIER_FLOOR = 32768
 
 
 def out_tier(n: int) -> int:
     """Padded finalized-CSR entry count for a dispatch with n bound hits."""
-    for tier in OUT_TIERS:
-        if n <= tier:
-            return tier
-    return bucket_size(n, 32768)
+    return snap(n, OUT_TIERS, OUT_TIER_FLOOR)
 
 
 def jit_cache_sizes() -> dict:

@@ -79,6 +79,11 @@ from accord_tpu.utils.invariants import Invariants
 _EMPTY_I32 = np.empty(0, dtype=np.int32)
 _EMPTY_I64 = np.empty(0, dtype=np.int64)
 
+# rents-key sentinel marking a range subject's OWN interval pieces in the
+# range-finalize entry table: the hit segment decodes as range-vs-range deps
+# (intersection with the subject's owned ranges) instead of key-point deps
+_RSUB = object()
+
 
 def _unpack_row(prow: np.ndarray) -> np.ndarray:
     """One subject's packed u32 result row -> int64 arena row indices."""
@@ -342,6 +347,14 @@ class _StoreArena:
         # the sum over a dispatch's (subject, key) slots gives a bound the
         # compaction output can never overflow (belt-and-braces checked)
         self.key_pop: Dict[object, int] = {}
+        # sorted int view of kid_of for the range-subject stab lane: binary
+        # searching a range piece's [start, end) against it enumerates the
+        # exact arena keys the piece covers (so range subjects reuse
+        # finalize_csr's kid masks instead of the host key-set walk).
+        # Invalidated only when a NEW kid is allocated -- kid ids persist
+        # across compaction. None-cached as unsupported when any key is not
+        # a plain int (ordering would not match interval containment).
+        self._key_index = None
         # bumped whenever a key's row-mask bits change on rows the device
         # may already have answered for: key-set widening of an EXISTING row
         # and prune/truncate clears. An in-flight finalized result whose
@@ -610,6 +623,7 @@ class _StoreArena:
                 kid = len(self.kid_of)
                 self.kid_of[key] = kid
                 self._key_of_kid[kid] = key
+                self._key_index = None
                 if kid >= self.kid_cap:
                     # dense id space overflowed the mirror: double and rebuild
                     self.kid_cap *= 2
@@ -922,6 +936,34 @@ class _StoreArena:
                     self._kid_dev, jnp.asarray(kid_idx),
                     jnp.asarray(word_idx), jnp.asarray(words))
         return self._kid_dev
+
+    def key_index(self):
+        """(keys_sorted int64[n], kids int32[n]) over every key the arena
+        has ever allotted a dense id, sorted by key -- the binary-search
+        index the range-subject stab lane enumerates covered keys from.
+        None when any key is not a plain int (a non-integer ordering could
+        disagree with interval containment, so those arenas answer range
+        subjects via the candidate re-filter instead). Cached until a new
+        kid is allocated; ids persist across compaction, so all-zero masks
+        (emptied keys) stay in the index and simply stab to nothing."""
+        idx = self._key_index
+        if idx is None:
+            for k in self.kid_of:
+                if type(k) is not int:
+                    self._key_index = idx = (None, None)
+                    break
+            else:
+                try:
+                    keys = np.fromiter(self.kid_of.keys(), dtype=np.int64,
+                                       count=len(self.kid_of))
+                except OverflowError:
+                    self._key_index = idx = (None, None)
+                else:
+                    kids = np.fromiter(self.kid_of.values(), dtype=np.int32,
+                                       count=len(self.kid_of))
+                    order = np.argsort(keys, kind="stable")
+                    self._key_index = idx = (keys[order], kids[order])
+        return None if idx[0] is None else idx
 
 
 class _RangeArena:
@@ -1257,7 +1299,9 @@ class _Group:
     __slots__ = ("store", "arena", "idx", "items", "gen", "rgen",
                  "pinned", "rpinned", "pk", "rp", "kp",
                  "kseq", "rseq", "fin_dev", "fin_np", "fin_slots",
-                 "rfin_dev", "rfin_np", "rents")
+                 "rfin_dev", "rfin_np", "rents",
+                 "rk_slots", "rkfin_dev", "rkfin_np",
+                 "fin_mat", "rmat", "rk_mat")
 
     def __init__(self, store, arena):
         self.store = store
@@ -1286,8 +1330,25 @@ class _Group:
         self.fin_slots = None
         self.rfin_dev = None
         self.rfin_np = None
-        # [(global interval-CSR entry, local item index, key)], or None
+        # [(global interval-CSR entry, local item index, key)] -- `key` is
+        # _RSUB for a range subject's own interval pieces -- or None
         self.rents = None
+        # range-subject KEY-arena stab lane: [(local item index, key)] per
+        # finalize_csr slot (empty list: planned with no covered arena
+        # keys; None: not planned -- candidate fallback), plus its device
+        # result and host copy
+        self.rk_slots = None
+        self.rkfin_dev = None
+        self.rkfin_np = None
+        # mutation-fence caches (_fence_finalized): lane results
+        # pre-materialized under still-valid pins just before an arena
+        # mutation would bump the sequence guards -- plain host objects,
+        # immune to the mutation, consumed by _decode_core at harvest.
+        # The range-side lanes cache stage 1 only (rows resolved to txn
+        # ids); their host-map filters run at harvest either way
+        self.fin_mat = None           # key lane: [KeyDeps] per item
+        self.rmat = None              # range lane: [(j, key, [txn ids])]
+        self.rk_mat = None            # rk lane: [(j, key, [txn ids])]
 
 
 def _dev_ready(dev) -> bool:
@@ -1358,6 +1419,8 @@ class _Call:
                 out.append((g, "fin_np", g.fin_dev))
             if g.rfin_dev is not None:
                 out.append((g, "rfin_np", g.rfin_dev))
+            if g.rkfin_dev is not None:
+                out.append((g, "rkfin_np", g.rkfin_dev))
         return out
 
     @property
@@ -1389,7 +1452,7 @@ class _Plan:
     harvest."""
 
     __slots__ = ("items", "groups", "key_call", "range_call", "empty",
-                 "fin_calls", "rfin_calls", "want")
+                 "fin_calls", "rfin_calls", "kfin_calls", "want")
 
     def __init__(self, items: List[_Item], groups: List[_Group],
                  empty: bool = False):
@@ -1401,8 +1464,10 @@ class _Plan:
         # finalize_on_device: deferred finalize kernel launches per group --
         # the key call consumes the packed result, the range call closes
         # over its group's interval-arena snapshot
-        self.fin_calls: List[tuple] = []    # [(group, packed -> triple)]
-        self.rfin_calls: List[tuple] = []   # [(group, () -> triple)]
+        self.fin_calls: List[tuple] = []    # [(group, packed -> result)]
+        self.rfin_calls: List[tuple] = []   # [(group, () -> result)]
+        # range-subject key-arena stab lane: consumes the kpacked result
+        self.kfin_calls: List[tuple] = []   # [(group, kpacked -> result)]
         # which raw candidate buffers the harvest should read back
         self.want = (True, True, True)
 
@@ -1441,6 +1506,18 @@ class BatchDepsResolver(DepsResolver):
     finalized_decodes = RegCounter("resolver.finalized_decodes")
     legacy_decodes = RegCounter("resolver.legacy_decodes")
     finalize_fallbacks = RegCounter("resolver.finalize_fallbacks")
+    # out-cap tier policy (ops/tiers.OutCapTiers): pinned-tier changes
+    # across every finalize lane, and the host cost of folding the
+    # device-computed bound back into the policy at harvest
+    outcap_tier_switches = RegCounter("resolver.outcap_tier_switches")
+    bound_readback_s = RegTimer("resolver.bound_readback_s")
+    # range subjects whose deps materialized straight from the device stab
+    # lanes (no host candidate re-filter)
+    range_subject_device_decodes = RegCounter(
+        "resolver.range_subject_device_decodes")
+    # host launch time of the sharded finalize compaction (per-shard
+    # popcount/prefix + gather-merge) on multi-device meshes
+    shard_merge_s = RegTimer("resolver.shard_merge_s")
     # adaptive staged window: scale adjustments per direction
     window_shrinks = RegCounter("resolver.window_shrinks")
     window_widens = RegCounter("resolver.window_widens")
@@ -1452,7 +1529,8 @@ class BatchDepsResolver(DepsResolver):
                  pad_store_tiers: Optional[int] = None,
                  finalize_on_device: bool = True,
                  adaptive_window: bool = False,
-                 kid_cap: int = 4096):
+                 kid_cap: int = 4096,
+                 device_out_bound: bool = True):
         # the registry backing every bench counter below (the class-level
         # RegCounter/RegTimer descriptors write through to it), BEFORE any
         # counter touch
@@ -1490,6 +1568,16 @@ class BatchDepsResolver(DepsResolver):
         # differential baseline (also the automatic per-group fallback when
         # a sequence guard trips mid-flight).
         self.finalize_on_device = finalize_on_device
+        # True (default): finalize out_caps come from the OutCapTiers
+        # hysteresis policy fed by the DEVICE-computed bound riding back
+        # with each finalize result -- no per-dispatch host O(keys)
+        # popcount pass (the host-exact bound seeds only the first, cold
+        # dispatch per arena). False: the legacy host-exact bound + out_tier
+        # snap per dispatch, the differential baseline.
+        self.device_out_bound = device_out_bound
+        # one tier policy per (arena, finalize lane): per-slot mean bounds
+        # are arena-contention properties, not resolver globals
+        self._octiers: Dict[tuple, "OutCapTiers"] = {}
         # opt-in: scale each node's staged dispatch window by drain
         # pressure (empty drains shrink it, full drains widen it)
         self.adaptive_window = adaptive_window
@@ -1570,6 +1658,30 @@ class BatchDepsResolver(DepsResolver):
             snap[f"resolver.upload_bytes.{k}"] = v
         return snap
 
+    # -- finalize out-cap policy ----------------------------------------------
+    def _note_tier_switch(self) -> None:
+        self.outcap_tier_switches += 1
+
+    def _outcap(self, arena, lane: str):
+        """The OutCapTiers policy pinning `lane`'s finalize out_cap for
+        `arena` (lanes: "key" subject deps, "range" interval stabs, "rkey"
+        range-subject key-arena stabs)."""
+        pol = self._octiers.get((id(arena), lane))
+        if pol is None:
+            from accord_tpu.ops.kernels import OUT_TIER_FLOOR, OUT_TIERS
+            from accord_tpu.ops.tiers import OutCapTiers
+            pol = self._octiers[(id(arena), lane)] = OutCapTiers(
+                OUT_TIERS, OUT_TIER_FLOOR, on_switch=self._note_tier_switch)
+        return pol
+
+    def _run_finalize_kernel(self, packed, j_off, kid_rows, j_subj, j_kid,
+                             j_srow, act_ts, out_cap: int):
+        """The finalize_csr launch point; the sharded resolver overrides it
+        with the mesh-compacted twin (per-shard counts + gather-merge)."""
+        from accord_tpu.ops.kernels import finalize_csr
+        return finalize_csr(packed, j_off, kid_rows, j_subj, j_kid, j_srow,
+                            act_ts, out_cap=out_cap)
+
     # -- arena plumbing -------------------------------------------------------
     def _arena(self, store) -> _StoreArena:
         arena = self._arenas.get(id(store))
@@ -1602,10 +1714,41 @@ class BatchDepsResolver(DepsResolver):
             # ranges stays on the host map, which the store merges itself)
             arena.ranges.update(txn_id, keys, status)
 
+    def _fence_finalized(self, store, arena) -> None:
+        """Mutation fence: pre-materialize in-flight finalized harvests
+        that pinned this arena BEFORE a truncation/prune bumps its
+        sequence guards. The finalize kernels already ran (launch
+        happened), the pins still certify their results, and the
+        materialized deps are plain host objects the mutation cannot
+        touch -- so the later harvest decodes from the cache instead of
+        paying the legacy-fallback readback. On a real device this
+        blocks on the in-flight transfer; truncation waves are rare
+        (durability cadence) next to the per-tick dispatch rate."""
+        q = self._inflight.get(id(store.node))
+        if not q:
+            return
+        for call in q:
+            for g in call.groups:
+                if g.arena is not arena:
+                    continue
+                key_ok = g.gen == arena.gen and g.kseq == arena.kseq
+                if g.fin_slots is not None and g.fin_mat is None and key_ok:
+                    g.fin_mat = self._materialize_finalized(call, g)
+                if g.rents is not None and g.rmat is None \
+                        and g.rgen == arena.ranges.gen \
+                        and g.rseq == arena.ranges.rseq:
+                    # stage 1 only: the host-map filters (stage 2) run at
+                    # harvest against post-mutation state, keeping fenced
+                    # and guarded harvests bit-identical
+                    g.rmat = self._stab_range_finalized(call, g)
+                if g.rk_slots is not None and g.rk_mat is None and key_ok:
+                    g.rk_mat = self._stab_rkey_finalized(call, g)
+
     def on_truncate(self, store, txn_id: TxnId) -> None:
         arena = self._arenas.get(id(store))
         if arena is None:
             return
+        self._fence_finalized(store, arena)
         row = arena.row_of.get(txn_id)
         if row is not None:
             # the arena is per store, so every key in the row is this
@@ -1616,6 +1759,7 @@ class BatchDepsResolver(DepsResolver):
     def on_prune(self, store, txn_id: TxnId, keys) -> None:
         arena = self._arenas.get(id(store))
         if arena is not None:
+            self._fence_finalized(store, arena)
             arena.remove_keys(txn_id, keys)
 
     # -- async batched path (the hot path) ------------------------------------
@@ -1789,6 +1933,9 @@ class BatchDepsResolver(DepsResolver):
                 g.fin_dev = fn(packed)
         for g, fn in plan.rfin_calls:
             g.rfin_dev = fn()
+        if kpacked is not None:
+            for g, fn in plan.kfin_calls:
+                g.rkfin_dev = fn(kpacked)
         return packed, rpacked, kpacked
 
     def _encode_plan(self, groups: List[_Group], items: List[_Item],
@@ -1836,6 +1983,10 @@ class BatchDepsResolver(DepsResolver):
         # item position, key) records -- key-subject point entries are 1:1
         # with keys, so the finalized range output routes by entry
         grents: List[List[Tuple[int, int, object]]] = [[] for _ in groups]
+        # finalize_on_device: each group's encodable RANGE subjects as
+        # (global item position, item, interval pieces) -- fed to the
+        # device stab lanes (_RSUB rents entries + the key-arena rk lane)
+        grsubs: List[List[tuple]] = [[] for _ in groups]
         for gi, g in enumerate(groups):
             ranges = g.arena.ranges
             for i, item in zip(g.idx, g.items):
@@ -1855,6 +2006,14 @@ class BatchDepsResolver(DepsResolver):
                     self.range_fallbacks += 1
                     continue
                 ghull[gi] = True
+                if self.finalize_on_device:
+                    # the subject's own pieces become _RSUB rents entries:
+                    # the device interval stab answers its range-vs-range
+                    # deps (per-piece hit segments union idempotently)
+                    base = len(givs[gi])
+                    grents[gi].extend((base + t, i, _RSUB)
+                                      for t in range(len(ivs)))
+                    grsubs[gi].append((i, item, ivs))
                 givs[gi].extend((i, s, e) for (s, e) in ivs)
             if ranges.encode_ok and ranges.count > 0:
                 # key subjects stab their store's interval rows with point
@@ -1997,14 +2156,30 @@ class BatchDepsResolver(DepsResolver):
             if self.finalize_on_device:
                 self._plan_range_finalize(plan, groups, grents, givs, nv,
                                           j_iv, j_sb, j_sknd)
+                # range subjects' KEY-arena deps: stab the sorted key index
+                # with each piece and reuse finalize_csr on the kpacked
+                # hull result -- exact row masks replace the host key-set
+                # walk of the candidate decode
+                for gi, g in enumerate(groups):
+                    if grsubs[gi] and g.kp is not None:
+                        self._plan_rkey_finalize(plan, g, grsubs[gi], b)
         if self.finalize_on_device:
-            # the finalized harvest reads only the compacted CSR triples;
-            # the raw candidate buffers stay device-resident unless a range
-            # SUBJECT needs the candidate decode (or a fallback fetches
-            # them lazily)
-            has_rsub = any(not isinstance(item.owned, Keys)
-                           and item.fallback is None for item in items)
-            plan.want = (False, has_rsub, has_rsub)
+            # the finalized harvest reads only the compacted CSR results;
+            # the raw candidate buffers stay device-resident (range
+            # subjects included -- the interval-stab + key-index lanes
+            # replace the candidate re-filter) unless some range subject's
+            # group could not plan a stab lane it needs; guard-tripped
+            # fallbacks still fetch lazily
+            want_rp = want_kp = False
+            for g in groups:
+                if not any(not isinstance(it.owned, Keys)
+                           and it.fallback is None for it in g.items):
+                    continue
+                if g.rp is not None and g.rents is None:
+                    want_rp = True
+                if g.kp is not None and g.rk_slots is None:
+                    want_kp = True
+            plan.want = (False, want_rp, want_kp)
         if pin:
             for g in groups:
                 if g.pk is not None or g.kp is not None:
@@ -2020,11 +2195,16 @@ class BatchDepsResolver(DepsResolver):
         in the EXACT order the legacy decode walks it (item order, keys
         sorted unique, keys without a row mask skipped -- bit-identity
         depends on this), the device kid/row-mask inputs, and an out_cap
-        tier sized from the exact per-key live-row popcount bound (the
-        compaction output can never overflow it while kseq holds)."""
+        tier from the OutCapTiers policy (device_out_bound: fed by the
+        DEVICE-computed bound riding back with each result, so no host
+        O(keys) popcount pass per dispatch; off or cold: the host-exact
+        popcount bound the compaction output can never overflow while
+        kseq holds)."""
         import jax.numpy as jnp
-        from accord_tpu.ops.kernels import finalize_csr, nnz_tier, out_tier
+        from accord_tpu.ops.kernels import nnz_tier, out_tier
         arena = g.arena
+        pol = self._outcap(arena, "key")
+        want_host_bound = not self.device_out_bound or pol.cold
         pos_of = {i: j for j, i in enumerate(g.idx)}
         flat_key: List[object] = []
         slot_subj: List[int] = []
@@ -2039,7 +2219,8 @@ class BatchDepsResolver(DepsResolver):
                 flat_key.append(k)
                 slot_subj.append(i)
                 slot_kid.append(arena.kid_of[k])
-                bound += arena.key_pop.get(k, 0)
+                if want_host_bound:
+                    bound += arena.key_pop.get(k, 0)
                 cnt += 1
             key_cnt[pos_of[i]] = cnt
         key_off = np.concatenate(([0], np.cumsum(key_cnt)))
@@ -2047,7 +2228,12 @@ class BatchDepsResolver(DepsResolver):
         if not flat_key:
             return      # no key has arena rows: the group decodes to EMPTY
         s = nnz_tier(len(flat_key))
-        out_cap = out_tier(max(bound, 1))
+        if not self.device_out_bound:
+            out_cap = out_tier(max(bound, 1))
+        elif want_host_bound:
+            out_cap = pol.pick(max(bound, 1))
+        else:
+            out_cap = pol.pick(pol.estimate(len(flat_key)))
         # padding slots use subject == b / kid == kid_cap: out of bounds,
         # masked off inside the kernel
         a_subj = np.full(s, b, dtype=np.int32)
@@ -2066,9 +2252,9 @@ class BatchDepsResolver(DepsResolver):
         plan.fin_calls.append((g, lambda packed, kid_rows=kid_rows,
                                j_subj=j_subj, j_kid=j_kid, j_srow=j_srow,
                                j_off=j_off, act_ts=act_ts, oc=out_cap:
-                               finalize_csr(packed, j_off, kid_rows, j_subj,
-                                            j_kid, j_srow, act_ts,
-                                            out_cap=oc)))
+                               self._run_finalize_kernel(
+                                   packed, j_off, kid_rows, j_subj, j_kid,
+                                   j_srow, act_ts, out_cap=oc)))
 
     def _plan_range_finalize(self, plan: _Plan, groups: List[_Group],
                              grents, givs, nv: int, j_iv, j_sb,
@@ -2087,7 +2273,7 @@ class BatchDepsResolver(DepsResolver):
         for gi, g in enumerate(groups):
             ents = grents[gi]
             ranges = g.arena.ranges
-            if not ents or ranges.count == 0 or not ranges.encode_ok:
+            if not ents or not ranges.encode_ok:
                 continue
             pos_of = {i: j for j, i in enumerate(g.idx)}
             base = offs[gi]
@@ -2095,8 +2281,16 @@ class BatchDepsResolver(DepsResolver):
             ent_ok = np.zeros(nv, dtype=bool)
             for e, _, _ in g.rents:
                 ent_ok[e] = True
+            # the bound here is host-O(1) (entries x live rows, no per-key
+            # pass), so it always feeds the policy exactly; the policy
+            # still pins the tier so quiet dispatches cannot flap the jit
+            # cache between ladder rungs
             nvalid = int(np.count_nonzero(ranges.valid[:ranges.count]))
-            out_cap = out_tier(max(len(g.rents) * nvalid, 1))
+            bound = max(len(g.rents) * nvalid, 1)
+            if self.device_out_bound:
+                out_cap = self._outcap(g.arena, "range").pick(bound)
+            else:
+                out_cap = out_tier(bound)
             rsnap = ranges.device_arrays()
             j_ok = jnp.asarray(ent_ok)
             plan.rfin_calls.append((g, lambda rsnap=rsnap, j_ok=j_ok,
@@ -2105,6 +2299,73 @@ class BatchDepsResolver(DepsResolver):
                                         j_iv[0], j_iv[1], j_iv[2], j_ok,
                                         j_sb, j_sknd, *rsnap, self._table,
                                         out_cap=oc)))
+
+    def _plan_rkey_finalize(self, plan: _Plan, g: _Group, rsubs,
+                            b: int) -> None:
+        """Cut one store's range-vs-KEY finalize call: each range subject's
+        owned pieces binary-search the arena's sorted key index to
+        enumerate exactly the keys they cover, and finalize_csr reuses the
+        group's kpacked hull span with one (subject, covered key) slot per
+        hit -- the device's exact kid row masks (plus its witness/before
+        lanes) replace the host candidate decode's per-row key-set walk.
+        Skipped entirely (rk_slots stays None -> candidate fallback +
+        kpacked readback) when the arena holds keys the int index cannot
+        order."""
+        import jax.numpy as jnp
+        from accord_tpu.ops.kernels import nnz_tier, out_tier
+        arena = g.arena
+        idx = arena.key_index()
+        if idx is None:
+            return
+        keys_sorted, kids_sorted = idx
+        pol = self._outcap(arena, "rkey")
+        want_host_bound = not self.device_out_bound or pol.cold
+        flat: List[tuple] = []
+        slot_subj: List[int] = []
+        slot_kid: List[int] = []
+        bound = 0
+        pos_of = {i: j for j, i in enumerate(g.idx)}
+        for i, item, ivs in rsubs:
+            j = pos_of[i]
+            for (s, e) in ivs:
+                lo = int(np.searchsorted(keys_sorted, s, side="left"))
+                hi = int(np.searchsorted(keys_sorted, e, side="left"))
+                for p in range(lo, hi):
+                    k = int(keys_sorted[p])
+                    flat.append((j, k))
+                    slot_subj.append(i)
+                    slot_kid.append(int(kids_sorted[p]))
+                    if want_host_bound:
+                        bound += arena.key_pop.get(k, 0)
+        g.rk_slots = flat
+        if not flat:
+            return      # no covered key has an arena id: decodes to EMPTY
+        s = nnz_tier(len(flat))
+        if not self.device_out_bound:
+            out_cap = out_tier(max(bound, 1))
+        elif want_host_bound:
+            out_cap = pol.pick(max(bound, 1))
+        else:
+            out_cap = pol.pick(pol.estimate(len(flat)))
+        a_subj = np.full(s, b, dtype=np.int32)
+        a_subj[:len(slot_subj)] = slot_subj
+        a_kid = np.full(s, arena.kid_cap, dtype=np.int32)
+        a_kid[:len(slot_kid)] = slot_kid
+        # range subjects hold no key-arena row; the materialize's txn-id
+        # check handles self-dependency like the legacy decode
+        subj_row = np.full(b, -1, dtype=np.int32)
+        kid_rows = arena.kid_arrays()
+        act_ts = arena.device_arrays()[1]
+        j_subj = jnp.asarray(a_subj)
+        j_kid = jnp.asarray(a_kid)
+        j_srow = jnp.asarray(subj_row)
+        j_off = jnp.asarray(g.kp[0], jnp.int32)
+        plan.kfin_calls.append((g, lambda kpacked, kid_rows=kid_rows,
+                                j_subj=j_subj, j_kid=j_kid, j_srow=j_srow,
+                                j_off=j_off, act_ts=act_ts, oc=out_cap:
+                                self._run_finalize_kernel(
+                                    kpacked, j_off, kid_rows, j_subj, j_kid,
+                                    j_srow, act_ts, out_cap=oc)))
 
     def _run_kernel(self, ksnap, subj_of, subj_keys, sb, sknd):
         """The single-store kernel call against a plan-time arena snapshot
@@ -2388,11 +2649,23 @@ class BatchDepsResolver(DepsResolver):
         buf = self._fetch_np(g, "fin_np", g.fin_dev)
         if buf is None:
             return None     # kernel never launched (defensive)
-        indptr, dep_rows, _ = buf
+        import time as _time
+        indptr, dep_rows, _, dbound = buf
         ns = len(flat_key)
+        # the device-computed bound rode back with the CSR: fold it into
+        # the out-cap policy so the NEXT dispatch's tier needs no host
+        # O(keys) popcount pass
+        t0 = _time.perf_counter()
+        pol = self._outcap(arena, "key")
+        pol.observe(int(dbound), ns)
+        self.bound_readback_s += _time.perf_counter() - t0
         total = int(indptr[ns])
         if total > dep_rows.shape[0]:
-            return None     # out_cap overflow (kseq changed mid-flight)
+            # out_cap overflow (estimate undershot or kseq changed
+            # mid-flight): bump the pinned tier so at most this one
+            # dispatch pays the legacy fallback
+            pol.overflowed()
+            return None
         h_slot = np.repeat(np.arange(ns), np.diff(indptr[:ns + 1]))
         h_row = dep_rows[:total].astype(np.int64)
         # covered maps are read at HARVEST time in both paths (the legacy
@@ -2411,35 +2684,160 @@ class BatchDepsResolver(DepsResolver):
                                        flat_cov, covered_any, slot_item,
                                        key_off, out)
 
-    def _materialize_range_finalized(self, call: _Call, g: _Group):
-        """Key subjects' range-txn deps from the device-exact stab: each
-        point-interval entry's CSR segment holds the rows whose interval,
-        witness, and before tests ALL passed on device, so the host work is
-        row -> txn id and builder insertion. None -> overflow or no buffer
-        (caller falls back to the candidate decode)."""
+    def _stab_range_finalized(self, call: _Call, g: _Group):
+        """Stage 1 of the interval-stab harvest (pin-dependent): resolve
+        each entry's CSR segment to txn ids through the arena's row->txn
+        table -- rgen/rseq holding certifies the mapping is the one the
+        kernel stabbed. Each segment's rows already passed the interval,
+        witness, and before tests ON DEVICE. Returns [(local item index,
+        key-or-_RSUB, [txn ids])] or None on overflow / no buffer. The
+        mutation fence runs this stage under still-valid pins; stage 2
+        (_finish_range_finalized) is host-map-dependent and always runs
+        at harvest."""
         if g.rfin_dev is None and g.rfin_np is None:
             return None
         buf = self._fetch_np(g, "rfin_np", g.rfin_dev)
         indptr, dep_rows, _ = buf
         if int(indptr[-1]) > dep_rows.shape[0]:
-            return None     # out_cap overflow (rseq changed mid-flight)
-        ranges = g.arena.ranges
-        ids = ranges.ids_np
-        builders: Dict[int, KeyDepsBuilder] = {}
+            # defensively bump the pinned tier (the host bound is exact, so
+            # only a mid-flight rseq change can land here)
+            self._outcap(g.arena, "range").overflowed()
+            return None
+        ids = g.arena.ranges.ids_np
+        raw: List[tuple] = []
         for e, j, k in g.rents:
             lo, hi = int(indptr[e]), int(indptr[e + 1])
             if lo == hi:
                 continue
+            tid = g.items[j].txn_id
+            raw.append((j, k, [rid for rid in
+                               (ids[row] for row in dep_rows[lo:hi])
+                               if rid is not None and rid != tid]))
+        return raw
+
+    def _finish_range_finalized(self, g: _Group, raw):
+        """Stage 2 (host-map-dependent): apply the store's CURRENT
+        range_txns membership and containment -- the exact filters the
+        legacy candidate decode applies at harvest time, so a
+        fence-cached stage 1 decodes bit-identically to the guarded
+        path even when a truncation landed in between. (While the guards
+        hold these filters are no-ops: rseq certifies every stabbed
+        row's txn is still registered with the same ranges.) Key-subject
+        point entries decode to that key's range-txn deps; _RSUB entries
+        (a range subject's own pieces) to its range-vs-range deps -- the
+        hit txn's ranges intersected with the subject's owned set.
+        Returns (kmap: item -> KeyDeps, rsub: item -> RangeDepsBuilder)
+        -- builders, so the key-arena rk lane can merge into them."""
+        builders: Dict[int, KeyDepsBuilder] = {}
+        rsub: Dict[int, RangeDepsBuilder] = {}
+        for j, k, rids in raw:
             item = g.items[j]
+            rt = item.store.range_txns
+            if k is _RSUB:
+                rb = rsub.get(j)
+                if rb is None:
+                    rb = rsub[j] = RangeDepsBuilder()
+                for rid in rids:
+                    rngs = rt.get(rid)
+                    if rngs is None:
+                        continue
+                    for r in rngs.intersection(item.owned):
+                        rb.add(r, rid)
+                continue
             kb = builders.get(j)
             if kb is None:
                 kb = builders[j] = KeyDepsBuilder()
-            for row in dep_rows[lo:hi]:
-                rid = ids[row]
-                if rid is None or rid == item.txn_id:
+            for rid in rids:
+                rngs = rt.get(rid)
+                if rngs is None or not rngs.contains_key(k):
                     continue
                 kb.add(k, rid)
-        return {j: kb.build() for j, kb in builders.items()}
+        return {j: kb.build() for j, kb in builders.items()}, rsub
+
+    def _materialize_range_finalized(self, call: _Call, g: _Group):
+        """Both stages of the interval-stab harvest (the guarded,
+        unfenced path). None on overflow / no buffer (caller falls back
+        to the candidate decode)."""
+        raw = self._stab_range_finalized(call, g)
+        if raw is None:
+            return None
+        return self._finish_range_finalized(g, raw)
+
+    def _materialize_rkey_finalized(self, call: _Call, g: _Group,
+                                    rsub: Dict[int, RangeDepsBuilder]) -> bool:
+        """Range subjects' KEY-arena deps from the device-exact rk lane:
+        each (subject, covered key) slot's CSR segment already passed the
+        exact kid row-mask, witness, and before tests on device, so the
+        host keeps only the rules the candidate decode also applies at
+        harvest time -- cfk membership, INVALIDATED status, and
+        covered-elision. Merges point deps into `rsub`'s builders. False ->
+        overflow or missing buffer (caller falls back to the candidate
+        decode)."""
+        raw = self._stab_rkey_finalized(call, g)
+        if raw is None:
+            return False
+        self._finish_rkey_finalized(g, raw, rsub)
+        return True
+
+    def _stab_rkey_finalized(self, call: _Call, g: _Group):
+        """Stage 1 of the rk-lane harvest (pin-dependent): per-slot dep
+        txn ids through the key arena's row->txn table (gen/kseq holding
+        certifies it). Returns [(local item index, key, [txn ids])] --
+        [] when the lane was planned with no covered arena keys -- or
+        None on overflow / missing buffer. The mutation fence runs this
+        under still-valid pins; stage 2 always runs at harvest."""
+        if not g.rk_slots:
+            return []       # planned, but no covered key had an arena id
+        if g.rkfin_dev is None and g.rkfin_np is None:
+            return None
+        buf = self._fetch_np(g, "rkfin_np", g.rkfin_dev)
+        import time as _time
+        indptr, dep_rows, _, dbound = buf
+        ns = len(g.rk_slots)
+        t0 = _time.perf_counter()
+        pol = self._outcap(g.arena, "rkey")
+        pol.observe(int(dbound), ns)
+        self.bound_readback_s += _time.perf_counter() - t0
+        if int(indptr[ns]) > dep_rows.shape[0]:
+            pol.overflowed()
+            return None
+        ids = g.arena.ids_np
+        raw: List[tuple] = []
+        for s, (j, k) in enumerate(g.rk_slots):
+            lo, hi = int(indptr[s]), int(indptr[s + 1])
+            if lo == hi:
+                continue
+            tid = g.items[j].txn_id
+            raw.append((j, k, [d for d in
+                               (ids[row] for row in dep_rows[lo:hi])
+                               if d is not None and d != tid]))
+        return raw
+
+    def _finish_rkey_finalized(self, g: _Group, raw,
+                               rsub: Dict[int, RangeDepsBuilder]) -> None:
+        """Stage 2 (host-map-dependent): cfk membership, INVALIDATED
+        status and covered-elision against the store's CURRENT maps --
+        the candidate decode's harvest-time rules -- merged into
+        `rsub`'s builders as point deps."""
+        for j, k, dep_ids in raw:
+            item = g.items[j]
+            c = item.store.cfks.get(k)
+            if c is None:
+                continue
+            cov = c.covered if c.covered else None
+            rb = rsub.get(j)
+            if rb is None:
+                rb = rsub[j] = RangeDepsBuilder()
+            pt = Range.point(k)
+            for dep_id in dep_ids:
+                info = c.get(dep_id)
+                if info is None or info.status == CfkStatus.INVALIDATED:
+                    continue
+                e = cov.get(dep_id) if cov else None
+                if e is not None and e[0] <= item.cover_seq \
+                        and e[1] < item.before:
+                    continue  # transitive-dependency elision (cfk rule)
+                rb.add(pt, dep_id)
 
     def _decode_key_range_deps(self, arena: _StoreArena, rgen: int,
                                rprow: np.ndarray, item: _Item):
@@ -2550,11 +2948,16 @@ class BatchDepsResolver(DepsResolver):
             key_stale = has_pk and g.gen != arena.gen
             gp = grp = gkp = None
             kds = None
-            if g.fin_slots is not None and not key_stale \
-                    and g.kseq == arena.kseq:
-                # device-finalized CSR harvest: exact rows, no raw readback
-                # (empty slot list short-circuits to all-EMPTY inside)
-                kds = self._materialize_finalized(call, g)
+            if g.fin_slots is not None:
+                if g.fin_mat is not None:
+                    # the mutation fence materialized this lane while its
+                    # pins still held; the cache survives the mutation
+                    kds = g.fin_mat
+                elif not key_stale and g.kseq == arena.kseq:
+                    # device-finalized CSR harvest: exact rows, no raw
+                    # readback (empty slot list short-circuits to
+                    # all-EMPTY inside)
+                    kds = self._materialize_finalized(call, g)
                 if kds is not None:
                     self.finalized_decodes += 1
             if kds is None and has_pk:
@@ -2565,23 +2968,52 @@ class BatchDepsResolver(DepsResolver):
                 if not key_stale:
                     kds = self._decode_batch(arena, g.items, gp)
                     self.legacy_decodes += 1
-            # range finalized output: exact per-entry segments for this
-            # group's KEY subjects (range subjects keep the candidate decode)
-            rkb = None
-            if g.rents is not None and g.rgen == arena.ranges.gen \
-                    and g.rseq == arena.ranges.rseq:
-                rkb = self._materialize_range_finalized(call, g)
+            # range finalized output: exact per-entry segments for the
+            # group's KEY subjects (kmap) and its range subjects'
+            # range-vs-range deps (rsub builders, from the _RSUB entries)
+            rkb = rsub_rb = None
+            if g.rents is not None:
+                raw_r = g.rmat
+                if raw_r is None and g.rgen == arena.ranges.gen \
+                        and g.rseq == arena.ranges.rseq:
+                    raw_r = self._stab_range_finalized(call, g)
+                if raw_r is not None:
+                    # stage 2 runs here either way: current host maps,
+                    # so fenced caches decode like guarded ones
+                    rkb, rsub_rb = self._finish_range_finalized(g, raw_r)
             if g.rents is not None and rkb is None:
                 self.finalize_fallbacks += 1
-            need_rp = has_rp and (
-                rkb is None
-                or any(not isinstance(it.owned, Keys) for it in g.items))
+            # range subjects decode on device only when EVERY stab lane
+            # they need materialized: the interval stab above and the
+            # key-arena rk lane below (each absent lane corresponds to an
+            # arena with no rows at plan time -- correctly empty)
+            has_rsub = any(not isinstance(it.owned, Keys)
+                           and it.fallback is None for it in g.items)
+            rsub_ok = has_rsub and self.finalize_on_device
+            if rsub_ok and g.rp is not None and rkb is None:
+                rsub_ok = False
+            if rsub_ok and g.kp is not None:
+                raw_rk = g.rk_mat
+                if raw_rk is None and g.rk_slots is not None \
+                        and not key_stale and g.gen == arena.gen \
+                        and g.kseq == arena.kseq:
+                    raw_rk = self._stab_rkey_finalized(call, g)
+                if raw_rk is None:
+                    rsub_ok = False
+                    if g.rk_slots is not None:
+                        self.finalize_fallbacks += 1
+                else:
+                    if rsub_rb is None:
+                        rsub_rb = {}
+                    self._finish_rkey_finalized(g, raw_rk, rsub_rb)
+            need_rp = has_rp and (rkb is None
+                                  or (has_rsub and not rsub_ok))
             if need_rp:
                 buf = self._fetch_np(call, "np_rpacked", call.rpacked)
                 if buf is not None:
                     grp = buf[idx][:, g.rp[0]:g.rp[1]]
             if has_kp and any(not isinstance(it.owned, Keys)
-                              for it in g.items):
+                              for it in g.items) and not rsub_ok:
                 buf = self._fetch_np(call, "np_kpacked", call.kpacked)
                 if buf is not None:
                     gkp = buf[idx][:, g.kp[0]:g.kp[1]]
@@ -2598,6 +3030,15 @@ class BatchDepsResolver(DepsResolver):
                         self.range_fallbacks += 1
                         results[g.idx[j]] = store.host_calculate_deps(
                             item.txn_id, item.owned, item.before)
+                        continue
+                    if rsub_ok:
+                        # fully device-resident: both stab lanes' builders
+                        # merged per item; absent builder -> no deps
+                        rb = rsub_rb.get(j) if rsub_rb else None
+                        results[g.idx[j]] = Deps(
+                            KeyDeps.EMPTY, rb.build()) if rb is not None \
+                            else Deps(KeyDeps.EMPTY)
+                        self.range_subject_device_decodes += 1
                         continue
                     d = self._decode_range_subject(
                         arena, g, grp[j] if grp is not None else None,
@@ -2946,13 +3387,15 @@ class ShardedBatchDepsResolver(BatchDepsResolver):
                  overlap_host: bool = True,
                  pad_store_tiers: Optional[int] = None,
                  finalize_on_device: bool = True,
-                 adaptive_window: bool = False, kid_cap: int = 4096):
+                 adaptive_window: bool = False, kid_cap: int = 4096,
+                 device_out_bound: bool = True):
         super().__init__(num_buckets, initial_cap,
                          fuse_cross_store=fuse_cross_store,
                          overlap_host=overlap_host,
                          pad_store_tiers=pad_store_tiers,
                          finalize_on_device=finalize_on_device,
-                         adaptive_window=adaptive_window, kid_cap=kid_cap)
+                         adaptive_window=adaptive_window, kid_cap=kid_cap,
+                         device_out_bound=device_out_bound)
         from accord_tpu.parallel.mesh import make_mesh
         self.mesh = mesh if mesh is not None else make_mesh()
         data = self.mesh.shape["data"]
@@ -2977,6 +3420,23 @@ class ShardedBatchDepsResolver(BatchDepsResolver):
         act_bm, act_ts, _, act_kinds, act_valid = ksnap
         return kern(subj_of, subj_keys, sb, sknd,
                     act_bm, act_ts, act_kinds, act_valid, self._table)
+
+    def _run_finalize_kernel(self, packed, j_off, kid_rows, j_subj, j_kid,
+                             j_srow, act_ts, out_cap: int):
+        # the finalize compaction shards its word columns over 'data': each
+        # shard popcounts and compacts ITS slice of every slot's row mask,
+        # an all-gather of the per-shard counts yields the global indptr
+        # plus each shard's write base, and a psum gather-merges the
+        # disjoint dep_rows fragments -- no chip ever materializes the full
+        # conflict matrix (lru_cached by mesh; launch time in shard_merge_s)
+        import time as _time
+        from accord_tpu.parallel.mesh import sharded_finalize_csr
+        kern = sharded_finalize_csr(self.mesh)
+        t0 = _time.perf_counter()
+        out = kern(packed, j_off, kid_rows, j_subj, j_kid, j_srow, act_ts,
+                   out_cap=out_cap)
+        self.shard_merge_s += _time.perf_counter() - t0
+        return out
 
     def _run_range_kernel(self, rsnap, ksnap, iv_of, iv_s, iv_e,
                           sb, sknd, srng):

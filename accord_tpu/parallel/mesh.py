@@ -16,17 +16,19 @@ step -- deps matrix + adjacency closure + execution wavefronts -- as one
 shard_map program jitted over the mesh; this is the multi-chip path the
 driver dry-runs and the scale-out story for >1 chip.
 
-Finalized-CSR harvest on the sharded path: the resolver's finalize kernels
-(ops.kernels.finalize_csr / range_finalize_csr) are plain jits consuming
-whatever packed result the (sharded) candidate kernels produced -- jit
-auto-reshards the lane-sharded packed words against the single-device kid
-table and interval lanes, so ShardedBatchDepsResolver inherits the
-device-side exact filtering + segment compaction without a mesh-specific
-twin. Lane order equals row order (cap % (32 * data) == 0), which is the
-property the finalize kernels' word indexing relies on. A real multi-chip
-deployment would shard the compaction itself (per-device segment counts +
-a cross-device exclusive scan); on the virtual CPU mesh the reshard cost
-is noise, so that remains an open scale-out item.
+Finalized-CSR harvest on the sharded path: the COMPACTION ITSELF is
+sharded (sharded_finalize_csr) -- each device ANDs and popcounts only ITS
+'data' slice of the word columns (kid table and packed candidate words
+both sharded P(None, 'data')), an all-gather of the per-(slot, shard)
+counts yields the global indptr plus every shard's exclusive write base
+inside each segment, and the disjoint per-shard dep_rows fragments
+gather-merge with one psum -- so no chip ever materializes a full
+(slots x cap) conflict matrix, closing what used to be this module's open
+scale-out item. Word order equals row order (cap % (32 * data) == 0) and
+shards partition words contiguously, so the merged CSR is bit-identical
+to the single-device kernel's. The interval-stab finalize
+(range_finalize_csr) stays a plain jit: the range arena is tiny (tens of
+rows) and carries no word-packed matrix worth sharding.
 """
 from __future__ import annotations
 
@@ -429,18 +431,133 @@ def sharded_fused_range_deps_resolve(mesh: Mesh, nr: int, nk: int):
     return call
 
 
+@functools.lru_cache(maxsize=8)
+def sharded_finalize_csr(mesh: Mesh):
+    """Mesh-sharded twin of ops.kernels.finalize_csr: the finalized-CSR
+    COMPACTION distributed over 'data' word columns. Each shard holds a
+    contiguous block of every kid-table row mask and of the packed
+    candidate words (P(None, 'data') -- the layout the sharded candidate
+    kernels already emit), so the AND + self-bit clear + SWAR popcount all
+    run on local slices and no device materializes the full
+    (slots x cap) bit matrix:
+
+      1. per-shard popcount -> counts_local i32[S];
+      2. all_gather over 'data' -> the global per-slot counts (summed:
+         indptr) AND each shard's exclusive prefix within its slot's
+         segment (write base);
+      3. each shard compacts ITS nonzero words at (segment base + lower
+         shards' counts + local bit prefix) -- disjoint global positions
+         by construction -- and emits its fragment as a 'data'-stacked
+         lane block; the fragments sum-merge (disjoint positions, zeros
+         elsewhere) outside the shard_map body, in the same jit.
+
+    Word order equals row order and shards partition words contiguously,
+    so (indptr, dep_rows, dep_ts, bound) is bit-identical to the
+    single-device finalize_csr. Overflow keeps the same contract
+    (indptr[-1] > out_cap; the exact total comes from the gathered counts,
+    never from the possibly-dropped scatters). lru_cached by mesh: every
+    resolver on the mesh shares one compiled kernel per (shape, out_cap)."""
+    from accord_tpu.ops.kernels import _popcount_u32
+    data = mesh.shape["data"]
+
+    def run(packed, word_off, kid_rows, slot_subj, slot_kid,
+            subj_row, act_ts, out_cap: int):
+        b = packed.shape[0]
+        kc, w = kid_rows.shape
+        blk = jax.lax.dynamic_slice_in_dim(packed, word_off, w, axis=1)
+
+        def part(blk_l, kid_l, ssub, skid, srow):
+            wl = blk_l.shape[1]
+            d = jax.lax.axis_index("data")
+            base_w = d * wl
+            s = ssub.shape[0]
+            ok = (ssub >= 0) & (ssub < b) & (skid >= 0) & (skid < kc)
+            kid_m = kid_l[jnp.clip(skid, 0, kc - 1)]
+            bound_l = jnp.sum(jnp.where(
+                ok, jnp.sum(_popcount_u32(kid_m), axis=1, dtype=jnp.int32),
+                0), dtype=jnp.int32)
+            so = jnp.clip(ssub, 0, b - 1)
+            m = jnp.where(ok[:, None], blk_l[so] & kid_m, jnp.uint32(0))
+            r = srow[so]
+            widx = base_w + jnp.arange(wl, dtype=jnp.int32)
+            selfbit = jnp.where(
+                (r >= 0)[:, None] & (widx[None, :] == (r >> 5)[:, None]),
+                (jnp.uint32(1) << (r & 31).astype(jnp.uint32))[:, None],
+                jnp.uint32(0))
+            m = m & ~selfbit
+            pop = _popcount_u32(m)                            # i32[S, wl]
+            counts_l = jnp.sum(pop, axis=1, dtype=jnp.int32)  # i32[S]
+            counts_all = jax.lax.all_gather(counts_l, "data")  # i32[D, S]
+            counts = jnp.sum(counts_all, axis=0)
+            seg0 = jnp.cumsum(counts, dtype=jnp.int32) - counts
+            # this shard's exclusive write base within each slot's segment
+            prefix = jnp.sum(jnp.where(
+                jnp.arange(data, dtype=jnp.int32)[:, None] < d,
+                counts_all, 0), axis=0, dtype=jnp.int32)
+            seg_base = seg0 + prefix
+            # local word compaction (kernels._packed_segment_compact with
+            # shard-global bit offsets and row bases)
+            flat_pop = pop.reshape(-1)
+            flat_val = m.reshape(-1)
+            within_seg = jnp.cumsum(pop, axis=1, dtype=jnp.int32) - pop
+            bit_off = (seg_base[:, None] + within_seg).reshape(-1)
+            nz = flat_pop > 0
+            slot = jnp.where(
+                nz, jnp.cumsum(nz.astype(jnp.int32), dtype=jnp.int32) - 1,
+                out_cap)
+            src = jnp.zeros(out_cap, jnp.int32) \
+                .at[slot].set(jnp.arange(s * wl, dtype=jnp.int32),
+                              mode="drop")
+            live = jnp.arange(out_cap, dtype=jnp.int32) \
+                < jnp.sum(nz.astype(jnp.int32))
+            cw_val = jnp.where(live, flat_val[src], jnp.uint32(0))
+            cw_off = bit_off[src]
+            cw_row = (base_w + src % wl) * 32
+            bits = ((cw_val[:, None] >> jnp.arange(32, dtype=jnp.uint32))
+                    & 1).astype(jnp.int32)
+            within = jnp.cumsum(bits, axis=1, dtype=jnp.int32) - bits
+            pos = jnp.where((bits > 0) & live[:, None],
+                            cw_off[:, None] + within, out_cap)
+            rows = cw_row[:, None] + jnp.arange(32, dtype=jnp.int32)[None, :]
+            frag = jnp.zeros(out_cap, jnp.int32) \
+                .at[pos.reshape(-1)].set(rows.reshape(-1), mode="drop")
+            return counts_l[None], frag[None], bound_l[None]
+
+        counts_all, frags, bounds = shard_map(
+            part, mesh=mesh,
+            in_specs=(P(None, "data"), P(None, "data"), P(None), P(None),
+                      P(None)),
+            out_specs=(P("data", None), P("data", None), P("data")),
+        )(blk, kid_rows, slot_subj, slot_kid, subj_row)
+        counts = jnp.sum(counts_all, axis=0)
+        indptr = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)])
+        dep_rows = jnp.sum(frags, axis=0)
+        bound = jnp.sum(bounds, dtype=jnp.int32)
+        dep_ts = act_ts[dep_rows]
+        return indptr, dep_rows, dep_ts, bound
+
+    return jax.jit(run, static_argnames=("out_cap",))
+
+
 def warmup_sharded(mesh: Mesh, num_buckets: int = 256, cap: int = 4096,
                    batch_tiers: Tuple[int, ...] = (8, 64, 128),
                    nnz_tiers: Optional[Tuple[int, ...]] = None,
                    range_cap: Optional[int] = None,
-                   store_tiers: Tuple[int, ...] = (1, 2)) -> None:
+                   store_tiers: Tuple[int, ...] = (1, 2),
+                   out_tiers: Tuple[int, ...] = (),
+                   kid_cap: int = 4096) -> None:
     """Pre-compile the sharded hot kernels' (batch tier, nnz tier, store
     tier) jit cross product (the sharded twin of ops.resolver.warmup; same
     padding ladders the overlapped pipeline dispatches). Store tiers >= 2
     warm the fused cross-store kernels; single-group dispatches reuse the
-    plain kernels. One call covers every ShardedBatchDepsResolver on the
-    same mesh + (num_buckets, cap, range_cap) -- the kernel builders are
-    lru_cached by (mesh, width) and jit caches by shape."""
+    plain kernels. `out_tiers` additionally warms the sharded finalize
+    compaction over (batch x nnz x out_cap) at `kid_cap` -- with the
+    resolver's OutCapTiers hysteresis pinning tiers, this covers every
+    finalize shape a steady-state burn dispatches. One call covers every
+    ShardedBatchDepsResolver on the same mesh + (num_buckets, cap,
+    range_cap) -- the kernel builders are lru_cached by (mesh, width) and
+    jit caches by shape."""
     from accord_tpu.ops.encoding import WITNESS_TABLE
     from accord_tpu.ops.kernels import NNZ_TIERS
     if nnz_tiers is None:
@@ -483,6 +600,25 @@ def warmup_sharded(mesh: Mesh, num_buckets: int = 256, cap: int = 4096,
                 rarenas = tuple((rs, re_, rts, rkd, rvl) for _ in range(s))
                 out = frkern(of, zz, zz, sst, sb, sknd, srng,
                              slots, rarenas, slots, arenas, table)
+    if out_tiers:
+        fin = sharded_finalize_csr(mesh)
+        w = cap // 32
+        kid_rows = jnp.zeros((kid_cap, w), jnp.uint32)
+        zero_off = jnp.asarray(0, jnp.int32)
+        for b in batch_tiers:
+            srow = jnp.full(b, -1, jnp.int32)
+            # the live packed words arrive lane-sharded out of the sharded
+            # candidate kernels; warm with the same committed sharding or
+            # the jit lowers a second, single-device entry
+            packed = jax.device_put(
+                jnp.zeros((b, w), jnp.uint32),
+                NamedSharding(mesh, P(None, "data")))
+            for z in nnz_tiers:
+                subj = jnp.full(z, b, jnp.int32)
+                kidx = jnp.full(z, kid_cap, jnp.int32)
+                for oc in out_tiers:
+                    out = fin(packed, zero_off, kid_rows, subj, kidx,
+                              srow, ts, out_cap=oc)
     if out is not None:
         jax.block_until_ready(out)
 

@@ -468,21 +468,19 @@ def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool,
     if device:
         from accord_tpu.ops.kernels import jit_cache_sizes
         cache1 = jit_cache_sizes()
-        # the finalize compaction out-caps are data-dependent pow2 buckets
-        # (sized from each dispatch's exact popcount bound), as are the
-        # kid-table dirty-word buckets: a contended burn can mint a new
-        # bucket at most once, ever, per shape. The large-replay bench
-        # asserts those kernels strictly (its tiers are predictable and
-        # pre-warmed); here every OTHER kernel must stay at zero.
-        data_tiered = ("finalize_csr", "range_finalize_csr",
-                       "kid_word_scatter")
+        # the finalize out-caps are hysteresis-pinned OutCapTiers rungs now
+        # (warmed below), so finalize_csr/range_finalize_csr sit under the
+        # strict zero-recompile assertion like everything else. Only the
+        # kid-table dirty-word buckets stay exempt: their pow2 tiers follow
+        # upload batch sizes, can mint at most once ever per shape, and are
+        # unrelated to the finalize ladder.
+        data_tiered = ("kid_word_scatter",)
         drift = {k: (cache0[k], cache1[k]) for k in cache1
                  if cache1[k] != cache0[k] and k not in data_tiered}
         if drift:
             raise AssertionError(
                 f"jit tiers compiled inside the e2e burn: {drift} "
-                "(warmup store_tiers coverage is stale)")
-        finalize_compiles = sum(cache1[k] - cache0[k] for k in data_tiered)
+                "(warmup store_tiers/out_tiers coverage is stale)")
         # fold every resolver's registry into one: the merged snapshot is
         # the single source for the stats below (the legacy attribute reads
         # are descriptor views over these same cells)
@@ -553,7 +551,8 @@ def bench_e2e_leg(seed: int, ops: int, concurrency: int, device: bool,
             "finalized_decodes": g("finalized_decodes"),
             "legacy_decodes": g("legacy_decodes"),
             "finalize_fallbacks": g("finalize_fallbacks"),
-            "finalize_tier_compiles": finalize_compiles,
+            "outcap_tier_switches": g("outcap_tier_switches"),
+            "range_subject_device_decodes": g("range_subject_device_decodes"),
             "prefetched": g("prefetched"),
             "stale_harvests": g("stale_harvests"),
             "host_fallbacks": g("host_fallbacks"),
@@ -679,10 +678,19 @@ def bench_range_mix(quick: bool):
     counters = {
         "host_fallbacks": sum(r.host_fallbacks for r in res_a),
         "range_fallbacks": sum(r.range_fallbacks for r in res_a),
+        # fully device-resident finalize: every group (range subjects
+        # included) must decode from the device CSR -- zero guard trips,
+        # zero legacy unpackbits decodes
+        "finalize_fallbacks": sum(r.finalize_fallbacks for r in res_a),
+        "legacy_decodes": sum(r.legacy_decodes for r in res_a),
     }
     bad = {k: v for k, v in counters.items() if v}
     if bad:
         raise AssertionError(f"range-mix burn left the device path: {bad}")
+    rsub_dev = sum(r.range_subject_device_decodes for r in res_a)
+    if rsub_dev == 0:
+        raise AssertionError(
+            "range-subject device stab never engaged in the range mix")
     return {
         "ops": ops,
         "range_ratio": 0.2,
@@ -691,6 +699,8 @@ def bench_range_mix(quick: bool):
         "wall_s": {"first": round(wall_a, 1), "replay": round(wall_b, 1)},
         "replay_identical": True,
         **counters,
+        "range_subject_device_decodes": rsub_dev,
+        "outcap_tier_switches": sum(r.outcap_tier_switches for r in res_a),
         "stale_harvests": sum(r.stale_harvests for r in res_a),
         "prefetched": sum(r.prefetched for r in res_a),
         "upload_bytes": sum(r.upload_bytes for r in res_a),
@@ -1014,9 +1024,22 @@ def main(argv=None) -> int:
         # plain kernels, warmed by store tier 1)
         # exec_caps=(1024,): the exec-plane leg's wait-graph arenas start at
         # 1024 rows; warm their per-field lane-delta scatters too
+        # out_tiers: the OutCapTiers ladder rungs the e2e burn's hysteresis
+        # picker can pin; with the finalize kernels now under the strict
+        # zero-recompile assertion these must be pre-compiled. The quick
+        # burn (200 ops) stays inside the first three rungs; the full burn
+        # (800 ops, 1024 in flight) piles hot-key populations high enough
+        # to pin 131072, and the headroomed estimate can overshoot the
+        # observed peak by one rung on a burst, hence 262144.
+        e2e_outs = ((256, 2048, 16384) if args.quick else
+                    (256, 2048, 16384, 32768, 65536, 131072, 262144))
+        # range_out_tiers=(256,): durability sync txns register RANGE
+        # rows, so key subjects stab the interval arena -- one small
+        # range compaction shape per burn (rents x nvalid stays tiny)
         warmup(num_buckets=E2E_BUCKETS, cap=E2E_ARENA_CAP,
                batch_tiers=(8, 64, 128, 256), scatter_tiers=(8, 64),
-               store_tiers=(1, 2), exec_caps=(1024,))
+               store_tiers=(1, 2), exec_caps=(1024,),
+               out_tiers=e2e_outs, range_out_tiers=(256,))
         # the large replay's admission windows dispatch anywhere between 129
         # and PIPE_BATCH subjects (~4 keys each), so every intermediate
         # subject tier and the 4096-entry CSR tier must be pre-compiled for

@@ -277,6 +277,153 @@ def test_fused_multi_store_finalized_differential():
     assert key_seen > 0 and range_seen > 0, "differential vacuous"
 
 
+def test_packed_segment_compact_overflow_signal():
+    """A nonzero-word count whose BIT total exceeds out_cap must surface as
+    indptr[-1] > out_cap -- the exact total, computed from popcounts before
+    any scatter can drop -- never as a silently truncated CSR that decodes
+    as a plausible-but-short dep list."""
+    import jax.numpy as jnp
+
+    from accord_tpu.ops.kernels import _packed_segment_compact
+
+    rng = np.random.default_rng(13)
+    m = rng.integers(0, 1 << 32, (4, 8), dtype=np.uint64).astype(np.uint32)
+    total = int(np.unpackbits(m.view(np.uint8)).sum())
+    out_cap = 32
+    assert total > out_cap  # dense random words: ~512 bits
+    indptr, dep_rows = _packed_segment_compact(jnp.asarray(m), out_cap)
+    indptr = np.asarray(indptr)
+    assert indptr[-1] == total > out_cap, "overflow signal lost"
+    # per-segment counts stay exact too (they come from the popcount pass)
+    pops = [int(np.unpackbits(row.view(np.uint8)).sum()) for row in m]
+    assert np.array_equal(np.diff(indptr), pops)
+
+    # and under the cap the compaction is the ground-truth bit walk
+    m2 = np.zeros((3, 2), np.uint32)
+    m2[0, 0] = 0b1010001
+    m2[1, 1] = 1 << 31
+    indptr2, rows2 = _packed_segment_compact(jnp.asarray(m2), 32)
+    indptr2, rows2 = np.asarray(indptr2), np.asarray(rows2)
+    assert indptr2.tolist() == [0, 3, 4, 4]
+    assert rows2[:4].tolist() == [0, 4, 6, 63]
+
+
+def test_out_cap_overflow_bumps_tier_and_falls_back_exactly():
+    """Force the hysteresis picker to pin an undersized out_cap (seed the
+    lane with a tiny observed bound), then resolve a subject with more deps
+    than the tier holds: the overflow must bump the ladder, the ONE
+    overflowing group must decode bit-identically through the legacy
+    fallback, and the next dispatch must finalize cleanly on the bumped
+    tier."""
+    rng = np.random.default_rng(17)
+    _, node, store = setup_store()
+    resolver = BatchDepsResolver(num_buckets=128, initial_cap=1024)
+    store.deps_resolver = resolver
+
+    hot = 7
+    for i in range(300):
+        ts = node.unique_now()
+        tid = TxnId.create(ts.epoch, ts.hlc, ts.node, TxnKind.WRITE,
+                           Domain.KEY)
+        ks = {hot} | {int(k) for k in rng.integers(0, 1 << 16, 2)}
+        store.register(tid, Keys(sorted(ks)), CfkStatus.WITNESSED, ts)
+
+    arena = resolver._arenas[id(store)]
+    pol = resolver._outcap(arena, "key")
+    pol.observe(8, 8)  # fake a quiet dispatch: estimate pins the 256 tier
+    assert not pol.cold
+
+    far = Timestamp(node.epoch, node.time_service.now_micros() + 50_000,
+                    0, node.id)
+    tid = node.next_txn_id(TxnKind.WRITE, Domain.KEY)
+    owned = store.owned(Keys([hot]))
+    host = store.host_calculate_deps(tid, owned, far)
+    assert len(host.key_deps.all_txn_ids()) >= 300  # > the 256 rung
+    dev = resolver.resolve_one(store, tid, owned, far)
+    assert dev == host, "overflow fallback diverged from the host scan"
+    assert resolver.finalize_fallbacks == 1
+    assert resolver.legacy_decodes == 1
+    assert pol.current >= 2048, "overflow did not bump the pinned tier"
+    assert resolver.outcap_tier_switches >= 1
+
+    # steady state after the bump: straight back to the finalized path
+    f0, ff0 = resolver.finalized_decodes, resolver.finalize_fallbacks
+    tid2 = node.next_txn_id(TxnKind.WRITE, Domain.KEY)
+    dev2 = resolver.resolve_one(store, tid2, owned, far)
+    assert dev2 == store.host_calculate_deps(tid2, owned, far)
+    assert resolver.finalized_decodes == f0 + 1
+    assert resolver.finalize_fallbacks == ff0
+    assert resolver.host_fallbacks == 0
+
+
+def test_device_bound_and_range_stab_randomized_differential():
+    """The retired host residuals, differentially: the default resolver
+    (device-computed out-cap bound + on-device range-subject stabbing) vs
+    the flagged host-bound baseline (device_out_bound=False) vs the legacy
+    unpackbits decode (finalize_on_device=False) -- all bit-identical to
+    the host scans over a randomized mixed workload with multi-piece range
+    subjects, before AND after truncation/prune churn."""
+    rng = np.random.default_rng(2718)
+    _, node, store = setup_store()
+    dev = BatchDepsResolver(num_buckets=128, initial_cap=128)
+    hostb = BatchDepsResolver(num_buckets=128, initial_cap=128,
+                              device_out_bound=False)
+    leg = BatchDepsResolver(num_buckets=128, initial_cap=128,
+                            finalize_on_device=False)
+    assert dev.device_out_bound
+    store.deps_resolver = dev
+    rids, tss = _register_mixed(store, node, rng)
+
+    def sweep(subs):
+        key_seen = range_seen = 0
+        for tid, owned, before in subs:
+            host = store.host_calculate_deps(tid, owned, before)
+            for r in (dev, hostb, leg):
+                store.deps_resolver = r
+                got = r.resolve_one(store, tid, owned, before)
+                assert got == host, f"{tid} diverged (bound/stab config)"
+            key_seen += bool(host.key_deps.all_txn_ids())
+            range_seen += bool(host.range_deps.all_txn_ids())
+        assert key_seen > 0 and range_seen > 0, "differential vacuous"
+
+    subs = _subjects(store, node, rng, tss, n=36)
+    # the population includes multi-piece range subjects (the per-piece
+    # segment lanes under test)
+    assert any(not isinstance(o, Keys) and len(list(o)) > 1
+               for _, o, _ in subs)
+    sweep(subs)
+    # the device path really decoded range subjects from the stab, with no
+    # legacy decode and no guard trips; the host-bound baseline rides the
+    # same finalized path (only the out_cap sizing differs)
+    assert dev.range_subject_device_decodes > 0
+    assert dev.legacy_decodes == 0 and dev.finalize_fallbacks == 0
+    assert hostb.range_subject_device_decodes > 0
+    assert hostb.legacy_decodes == 0 and hostb.finalize_fallbacks == 0
+    assert leg.legacy_decodes > 0 and leg.finalized_decodes == 0
+
+    # truncate half the range txns + prune a few key entries, mirrored into
+    # every resolver (store._deregister fans out the same way), then the
+    # whole differential must keep holding on the shrunk arenas
+    for tid in rids[::2]:
+        store.range_txns.pop(tid, None)
+        store.range_index.remove(tid)
+        for r in (dev, hostb, leg):
+            r.on_truncate(store, tid)
+    pruned = 0
+    for key in sorted(store.cfks)[:6]:
+        cfk = store.cfks[key]
+        for t in sorted(cfk._infos)[:1]:
+            cfk.remove(t)
+            for r in (dev, hostb, leg):
+                r.on_prune(store, t, (key,))
+            pruned += 1
+    assert pruned > 0
+    sweep(_subjects(store, node, rng, tss, n=24))
+    for r in (dev, hostb, leg):
+        assert r.host_fallbacks == 0
+        assert r.range_fallbacks == 0
+
+
 def test_finalized_truncation_output_cap_growth():
     """Dep lists wider than the first OUT_TIER must grow the output
     capacity tier, not truncate: one hot key touched by hundreds of txns

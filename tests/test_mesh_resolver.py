@@ -109,3 +109,94 @@ def test_burn_sharded_matches_host_resolver_log():
                                            deps_batch_window_ms=None),
                    **kw)
     assert host.acked == dev.acked == 80
+
+
+def test_sharded_finalize_kernel_matches_single_device():
+    """The sharded compaction twin: per-shard popcount/prefix fragments
+    gather-merged into the global CSR must be BIT-identical to
+    kernels.finalize_csr -- indptr, dep_rows, dep_ts and the fused bound
+    scalar -- including fused word spans (word_off != 0) and overflow
+    (where both sides must still report the exact total)."""
+    import jax.numpy as jnp
+    from accord_tpu.ops.kernels import finalize_csr
+    from accord_tpu.parallel.mesh import sharded_finalize_csr
+
+    mesh = make_mesh()
+    data = mesh.shape["data"]
+    cap = 32 * data * 4
+    w = cap // 32
+    kern = sharded_finalize_csr(mesh)
+    rng = np.random.default_rng(23)
+    overflowed = fit = 0
+    for trial, (density, out_cap, spans, off) in enumerate(
+            ((0.004, 256, 1, 0), (0.02, 256, 2, w), (0.5, 64, 1, 0))):
+        b, s, kc = 8, 32, 64
+        packed = (rng.random((b, spans * w, 32)) < density)
+        packed = np.packbits(packed, axis=-1, bitorder="little") \
+            .view(np.uint32).reshape(b, spans * w)
+        kid = (rng.random((kc, w, 32)) < 0.1)
+        kid = np.packbits(kid, axis=-1, bitorder="little") \
+            .view(np.uint32).reshape(kc, w)
+        args = (jnp.asarray(packed), jnp.asarray(off, jnp.int32),
+                jnp.asarray(kid),
+                jnp.asarray(rng.integers(-1, b + 2, s), jnp.int32),
+                jnp.asarray(rng.integers(0, kc + 1, s), jnp.int32),
+                jnp.asarray(rng.integers(-1, cap, b), jnp.int32),
+                jnp.asarray(rng.integers(0, 1 << 20, (cap, 3)), jnp.int32))
+        single = finalize_csr(*args, out_cap=out_cap)
+        sharded = kern(*args, out_cap=out_cap)
+        for name, a, c in zip(("indptr", "dep_rows", "dep_ts", "bound"),
+                              single, sharded):
+            assert np.array_equal(np.asarray(a), np.asarray(c)), \
+                f"trial {trial}: sharded {name} != single-device"
+        total = int(np.asarray(single[0])[-1])
+        overflowed += total > out_cap
+        fit += 0 < total <= out_cap
+    assert overflowed and fit, "differential vacuous"
+
+
+def test_sharded_finalize_e2e_and_zero_recompiles():
+    """The sharded resolver rides the finalized-CSR harvest end to end
+    (answers == single-device == host, zero legacy decodes), and after
+    warmup_sharded(out_tiers=...) the live workload mints NO new sharded
+    finalize compiles -- the OutCapTiers rungs are the whole shape space."""
+    from accord_tpu.ops.resolver import (BatchDepsResolver,
+                                         ShardedBatchDepsResolver)
+    from accord_tpu.parallel.mesh import sharded_finalize_csr, warmup_sharded
+    from accord_tpu.primitives.keyspace import Keys
+    from accord_tpu.primitives.timestamp import Domain, Timestamp, TxnKind
+
+    c = Cluster(37, ClusterConfig())
+    _drive_writes(c, 24)
+    node = c.nodes[1]
+    mesh = make_mesh()
+    # resolve_one dispatches pad to batch tier 8 / nnz tier 32; the cold
+    # first pick seeds from the exact bound (small workload -> first rung)
+    warmup_sharded(mesh, num_buckets=256, cap=512, batch_tiers=(8,),
+                   nnz_tiers=(32,), store_tiers=(1,), out_tiers=(256,))
+    fin = sharded_finalize_csr(mesh)
+    warmed = fin._cache_size()
+    assert warmed > 0
+
+    sharded = ShardedBatchDepsResolver(mesh=mesh, num_buckets=256,
+                                       initial_cap=512)
+    single = BatchDepsResolver(num_buckets=256, initial_cap=512)
+    before = Timestamp(node.epoch, node.time_service.now_micros() + 10_000,
+                       0, node.id)
+    checked = 0
+    for store in node.command_stores.all():
+        for key in store.cfks:
+            subj = node.next_txn_id(TxnKind.WRITE, Domain.KEY)
+            owned = store.owned(Keys([key]))
+            host = store.host_calculate_deps(subj, owned, before)
+            assert single.resolve_one(store, subj, owned, before) == host
+            assert sharded.resolve_one(store, subj, owned, before) == host
+            checked += 1
+    assert checked >= 5, f"only {checked} keys exercised"
+    assert sharded.finalized_decodes > 0, "sharded finalize never engaged"
+    assert sharded.legacy_decodes == 0
+    assert sharded.finalize_fallbacks == 0
+    assert sharded.host_fallbacks == 0
+    assert sharded.shard_merge_s > 0.0, "sharded merge timer never ran"
+    assert fin._cache_size() == warmed, \
+        "live workload minted sharded finalize compiles past warmup"
