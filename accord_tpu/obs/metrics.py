@@ -321,6 +321,15 @@ GLOSSARY: Dict[str, str] = {
     "resolver.shard_merge_s": "sharded finalize launch + fragment-merge wall seconds",
     "resolver.window_shrinks": "adaptive window scale-down adjustments",
     "resolver.window_widens": "adaptive window scale-up adjustments",
+    # -- resolver device-plane fault handling (ops/fault_plane.py) -----------
+    "resolver.device_faults_injected": "injected device faults consumed by the pipeline",
+    "resolver.device_retries": "bounded dispatch retries + watchdog probes spent",
+    "resolver.device_watchdog_trips": "harvests declared wedged/late by the watchdog",
+    "resolver.checksum_mismatches": "corrupted harvests caught by the finalize checksum lane",
+    "resolver.degraded_dispatches": "dispatches answered host-side (give-ups + quarantine reroutes)",
+    "resolver.quarantine_entries": "node health transitions into QUARANTINED",
+    "resolver.quarantine_exits": "probation ladders completed back to HEALTHY",
+    "resolver.device_canaries": "probation canary dispatches double-decoded",
     # -- resolver computed gauges (folded into resolver.snapshot()) ----------
     "resolver.host_hidden_pct": "share of host phase time hidden in the device window",
     "resolver.upload_bytes": "bytes shipped host->device by arena scatters",

@@ -546,6 +546,42 @@ def _packed_segment_compact(m, out_cap: int):
     return indptr, dep_rows
 
 
+def _csum_fold(x, seed: int):
+    """Position-weighted fold of one CSR lane into a u32 word: bitcast to
+    u32, mix the high half down, then a wrapping sum weighted by odd
+    per-position multipliers (odd => invertible mod 2^32, so transposing
+    or flipping any element changes the sum). Runs in-jit on device; the
+    host twin is csr_checksum_host. Sums mod 2^32 are order-independent,
+    so device reduction order cannot diverge from numpy's."""
+    v = jax.lax.bitcast_convert_type(x.reshape(-1), jnp.uint32)
+    v = v ^ (v >> jnp.uint32(16))
+    idx = jnp.arange(v.shape[0], dtype=jnp.uint32)
+    return jnp.sum(v * (jnp.uint32(2) * idx + jnp.uint32(seed)),
+                   dtype=jnp.uint32)
+
+
+def csr_checksum(indptr, dep_rows, dep_ts):
+    """Device-side integrity word over a finalized CSR triple, fused into
+    the finalize kernels' returns and re-derived from the host copies at
+    harvest (resolver._csum_ok): a readback that arrives bit-flipped can
+    never decode into wrong deps -- the mismatch routes the group to the
+    legacy fallback, which re-reads the raw candidate buffers."""
+    return (_csum_fold(indptr, 1) ^ _csum_fold(dep_rows, 5)
+            ^ _csum_fold(dep_ts, 9))
+
+
+def csr_checksum_host(indptr, dep_rows, dep_ts) -> int:
+    """numpy twin of csr_checksum, computed over the fetched host copies.
+    Must track the device fold bit for bit."""
+    def fold(x, seed):
+        v = np.ascontiguousarray(x).view(np.uint32).reshape(-1)
+        v = v ^ (v >> np.uint32(16))
+        idx = np.arange(v.shape[0], dtype=np.uint32)
+        return (v * (np.uint32(2) * idx + np.uint32(seed))).sum(
+            dtype=np.uint32)
+    return int(fold(indptr, 1) ^ fold(dep_rows, 5) ^ fold(dep_ts, 9))
+
+
 @functools.partial(jax.jit, static_argnames=("out_cap",))
 def finalize_csr(packed, word_off, kid_rows, slot_subj, slot_kid,
                  subj_row, act_ts, out_cap: int):
@@ -573,13 +609,14 @@ def finalize_csr(packed, word_off, kid_rows, slot_subj, slot_kid,
     act_ts:    i32[cap, 3]     the arena's txn-id lanes; gathered through the
                                compacted rows so RESULTS ARE TXN IDS
     -> (indptr i32[S+1], dep_rows i32[out_cap], dep_ts i32[out_cap, 3],
-        bound i32 scalar);
+        bound i32 scalar, csum u32 scalar);
        dep order within a slot is ascending arena row; indptr[-1] > out_cap
        signals overflow. `bound` is the segmented reduction over the slots'
        kid-table row masks -- exactly the host popcount bound
        (sum of key_pop over the dispatch's slot keys) -- read back with the
        result so the NEXT dispatch's out_cap tier needs no host O(keys)
-       pass (resolver's OutCapTiers policy).
+       pass (resolver's OutCapTiers policy). `csum` is the csr_checksum
+       integrity word over the triple, verified at harvest.
     """
     b = packed.shape[0]
     kc, w = kid_rows.shape
@@ -600,7 +637,8 @@ def finalize_csr(packed, word_off, kid_rows, slot_subj, slot_kid,
     m = m & ~selfbit
     indptr, dep_rows = _packed_segment_compact(m, out_cap)
     dep_ts = act_ts[dep_rows]
-    return indptr, dep_rows, dep_ts, bound
+    return (indptr, dep_rows, dep_ts, bound,
+            csr_checksum(indptr, dep_rows, dep_ts))
 
 
 @functools.partial(jax.jit, static_argnames=("out_cap",))
@@ -620,8 +658,10 @@ def range_finalize_csr(iv_of, iv_start, iv_end, ent_ok,
     range_deps_resolve; `ent_ok` gates which entries finalize (entries of
     the targeted store).
 
-    -> (indptr i32[NV+1], dep_rows i32[out_cap], dep_ts i32[out_cap, 3]);
-       dep_ts carries the range arena's txn-id lanes so results are txn ids.
+    -> (indptr i32[NV+1], dep_rows i32[out_cap], dep_ts i32[out_cap, 3],
+        csum u32 scalar -- the csr_checksum integrity word, verified at
+        harvest); dep_ts carries the range arena's txn-id lanes so results
+       are txn ids.
     """
     b = subj_before.shape[0]
     o = jnp.clip(iv_of, 0, b - 1)
@@ -633,7 +673,8 @@ def range_finalize_csr(iv_of, iv_start, iv_end, ent_ok,
     m = hit & witness & before & r_valid[None, :] & inb[:, None]
     indptr, dep_rows = _segment_compact(m.astype(jnp.int32), out_cap)
     dep_ts = r_ts[dep_rows]
-    return indptr, dep_rows, dep_ts
+    return (indptr, dep_rows, dep_ts,
+            csr_checksum(indptr, dep_rows, dep_ts))
 
 
 @jax.jit

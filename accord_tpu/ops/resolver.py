@@ -1385,7 +1385,9 @@ class _Call:
     fallback fetches them lazily -- blocking, and counted as readback."""
 
     __slots__ = ("packed", "rpacked", "kpacked", "items", "groups",
-                 "np_packed", "np_rpacked", "np_kpacked", "want", "did")
+                 "np_packed", "np_rpacked", "np_kpacked", "want", "did",
+                 "stuck_left", "corrupt_pending", "overflow_pending",
+                 "degraded", "faulted", "canary")
 
     def __init__(self, packed, rpacked, kpacked, items, groups,
                  want=(True, True, True), did=-1):
@@ -1403,6 +1405,17 @@ class _Call:
         # monotone dispatch id (per resolver): keys this call's device-
         # window span in the flight recorder (-1: sync path, untraced)
         self.did = did
+        # device-plane fault state (ops/fault_plane.py): pending injected
+        # faults to consume at harvest, whether the call was given up on
+        # (decode answers host-side), whether any fault landed on it (the
+        # health ladder's clean-dispatch gate), and whether this dispatch
+        # is a probation canary
+        self.stuck_left = 0
+        self.corrupt_pending = False
+        self.overflow_pending = False
+        self.degraded = False
+        self.faulted = False
+        self.canary = False
 
     def buffers(self):
         """(holder, host attr, device value) triples the async-copy / poll /
@@ -1521,6 +1534,19 @@ class BatchDepsResolver(DepsResolver):
     # adaptive staged window: scale adjustments per direction
     window_shrinks = RegCounter("resolver.window_shrinks")
     window_widens = RegCounter("resolver.window_widens")
+    # device-plane fault tolerance (ops/fault_plane.py): applied fault
+    # injections, bounded launch retries + harvest re-probes, watchdog
+    # trips on wedged calls, checksum-lane catches before decode, and the
+    # health ladder's traffic (host-routed dispatches, quarantine
+    # entries/exits, probation canaries)
+    device_faults_injected = RegCounter("resolver.device_faults_injected")
+    device_retries = RegCounter("resolver.device_retries")
+    device_watchdog_trips = RegCounter("resolver.device_watchdog_trips")
+    checksum_mismatches = RegCounter("resolver.checksum_mismatches")
+    degraded_dispatches = RegCounter("resolver.degraded_dispatches")
+    quarantine_entries = RegCounter("resolver.quarantine_entries")
+    quarantine_exits = RegCounter("resolver.quarantine_exits")
+    device_canaries = RegCounter("resolver.device_canaries")
 
     def __init__(self, num_buckets: int = 256, initial_cap: int = 4096,
                  max_dispatch: Optional[int] = None,
@@ -1530,7 +1556,12 @@ class BatchDepsResolver(DepsResolver):
                  finalize_on_device: bool = True,
                  adaptive_window: bool = False,
                  kid_cap: int = 4096,
-                 device_out_bound: bool = True):
+                 device_out_bound: bool = True,
+                 verify_checksums: bool = True,
+                 retry_limit: int = 2,
+                 watchdog_probes: int = 3,
+                 watchdog_wall_s: Optional[float] = None,
+                 health_config: Optional[dict] = None):
         # the registry backing every bench counter below (the class-level
         # RegCounter/RegTimer descriptors write through to it), BEFORE any
         # counter touch
@@ -1609,6 +1640,20 @@ class BatchDepsResolver(DepsResolver):
         # initial _RangeArena capacity (the sharded resolver widens it to
         # keep rcap % (32*data) == 0)
         self.range_cap = 64
+        # device-plane fault tolerance: re-derive the finalize kernels'
+        # fused checksum word from the host copies at harvest (a corrupted
+        # readback can never decode into wrong deps -- it falls back to the
+        # legacy decode of the raw candidate buffers); bounded launch
+        # retries; a harvest watchdog with a deterministic probe budget
+        # (plus an optional wall budget for real devices -- None keeps sim
+        # runs free of wall-clock-dependent state); and one DeviceHealth
+        # ladder per node (HEALTHY -> DEGRADED -> QUARANTINED -> PROBATION)
+        self.verify_checksums = verify_checksums
+        self.retry_limit = retry_limit
+        self.watchdog_probes = watchdog_probes
+        self.watchdog_wall_s = watchdog_wall_s
+        self.health_config = health_config
+        self._health: Dict[int, "DeviceHealth"] = {}
 
     @property
     def host_hidden_pct(self) -> float:
@@ -1681,6 +1726,95 @@ class BatchDepsResolver(DepsResolver):
         from accord_tpu.ops.kernels import finalize_csr
         return finalize_csr(packed, j_off, kid_rows, j_subj, j_kid, j_srow,
                             act_ts, out_cap=out_cap)
+
+    # -- device health + fault handling ---------------------------------------
+    def _node_health(self, node) -> "DeviceHealth":
+        """The node's DeviceHealth ladder, created on first fault (healthy
+        runs never allocate one -- _health.get() elsewhere stays None)."""
+        h = self._health.get(id(node))
+        if h is None:
+            from accord_tpu.ops.fault_plane import DeviceHealth
+            cfg = self.health_config or {}
+            h = self._health[id(node)] = DeviceHealth(
+                on_transition=lambda old, new:
+                    self._health_transition(node, old, new), **cfg)
+        return h
+
+    def _health_transition(self, node, old: str, new: str) -> None:
+        from accord_tpu.ops import fault_plane as fp
+        if new == fp.QUARANTINED:
+            self.quarantine_entries += 1
+        if old == fp.PROBATION and new == fp.HEALTHY:
+            self.quarantine_exits += 1
+        if REC.enabled:
+            REC.instant(node_pid(node), "device", f"health:{old}->{new}",
+                        node_ts(node), args={"from": old, "to": new})
+
+    def _csum_ok(self, call: "_Call", g: "_Group", buf) -> bool:
+        """Harvest-side integrity check of one finalized lane: re-derive
+        the fused checksum word from the fetched host copies. A mismatch
+        (corrupted readback) is counted, drives the node's health ladder,
+        and returns False so the caller routes the group to the legacy
+        fallback -- wrong deps are never delivered. The trailing bound
+        word is NOT covered: it only feeds the out-cap sizing policy,
+        which self-corrects through the overflow bump."""
+        if not self.verify_checksums:
+            return True
+        from accord_tpu.ops.kernels import csr_checksum_host
+        if csr_checksum_host(buf[0], buf[1], buf[2]) == int(buf[-1]):
+            return True
+        self.checksum_mismatches += 1
+        call.faulted = True
+        node = g.store.node
+        self._node_health(node).on_fault("corrupt")
+        if REC.enabled:
+            REC.instant(node_pid(node), "device", "checksum_mismatch",
+                        node_ts(node), args={"did": call.did})
+        return False
+
+    def _apply_corruption(self, call: "_Call", plane) -> None:
+        """Consume a pending corrupt injection: flip one bit in the first
+        fetched finalize triple's host copy (writable clone -- the fetched
+        arrays may be read-only views of device buffers). Dropped when the
+        call carried no finalized lane (nothing checksummed to corrupt)."""
+        for g in call.groups:
+            for attr in ("fin_np", "rfin_np", "rkfin_np"):
+                buf = getattr(g, attr)
+                if buf is None:
+                    continue
+                arrs = [np.array(a) for a in buf[:3]]
+                if plane.corrupt_arrays(arrs):
+                    setattr(g, attr, tuple(arrs) + tuple(buf[3:]))
+                    self.device_faults_injected += 1
+                    return
+        # no finalized buffer on this call: injection dropped, uncounted
+
+    def _canary_check(self, call: "_Call", g: "_Group", kds) -> None:
+        """Probation canary: re-decode this group's key lane through the
+        legacy unpackbits path against the SAME plan-time snapshot (lazy
+        raw-buffer fetch; warmed tiers, zero recompiles) and compare. A
+        match walks the health ladder toward HEALTHY; a divergence means
+        the device compaction itself is untrustworthy -- straight back to
+        quarantine. The finalized result is still delivered either way:
+        the sequence guards + checksum already certify it bit-identical
+        to the guarded decode, so histories stay fault-free-identical."""
+        if call.packed is None and call.np_packed is None:
+            return
+        if g.pk is None:
+            return
+        self.device_canaries += 1
+        buf = self._fetch_np(call, "np_packed", call.packed)
+        if buf is None:
+            return
+        idx = np.asarray(g.idx, np.int64)
+        gp = buf[idx][:, g.pk[0]:g.pk[1]]
+        legacy = self._decode_batch(g.arena, g.items, gp)
+        h = self._node_health(g.store.node)
+        if list(legacy) == list(kds):
+            h.canary_ok()
+        else:
+            call.faulted = True
+            h.canary_failed()
 
     # -- arena plumbing -------------------------------------------------------
     def _arena(self, store) -> _StoreArena:
@@ -2649,8 +2783,10 @@ class BatchDepsResolver(DepsResolver):
         buf = self._fetch_np(g, "fin_np", g.fin_dev)
         if buf is None:
             return None     # kernel never launched (defensive)
+        if not self._csum_ok(call, g, buf):
+            return None     # corrupted readback: caught before decode
         import time as _time
-        indptr, dep_rows, _, dbound = buf
+        indptr, dep_rows, _, dbound, _ = buf
         ns = len(flat_key)
         # the device-computed bound rode back with the CSR: fold it into
         # the out-cap policy so the NEXT dispatch's tier needs no host
@@ -2659,6 +2795,18 @@ class BatchDepsResolver(DepsResolver):
         pol = self._outcap(arena, "key")
         pol.observe(int(dbound), ns)
         self.bound_readback_s += _time.perf_counter() - t0
+        if call is not None and call.overflow_pending:
+            # injected out-cap overflow storm: report the overflow signal
+            # without shrinking/garbling anything -- the policy bumps its
+            # pinned tier and this one group pays the legacy fallback
+            call.overflow_pending = False
+            call.faulted = True
+            from accord_tpu.ops import fault_plane
+            if fault_plane.ACTIVE is not None:
+                fault_plane.ACTIVE.note("overflow")
+                self.device_faults_injected += 1
+            pol.overflowed()
+            return None
         total = int(indptr[ns])
         if total > dep_rows.shape[0]:
             # out_cap overflow (estimate undershot or kseq changed
@@ -2697,7 +2845,9 @@ class BatchDepsResolver(DepsResolver):
         if g.rfin_dev is None and g.rfin_np is None:
             return None
         buf = self._fetch_np(g, "rfin_np", g.rfin_dev)
-        indptr, dep_rows, _ = buf
+        if not self._csum_ok(call, g, buf):
+            return None     # corrupted readback: caught before decode
+        indptr, dep_rows, _, _ = buf
         if int(indptr[-1]) > dep_rows.shape[0]:
             # defensively bump the pinned tier (the host bound is exact, so
             # only a mid-flight rseq change can land here)
@@ -2791,8 +2941,10 @@ class BatchDepsResolver(DepsResolver):
         if g.rkfin_dev is None and g.rkfin_np is None:
             return None
         buf = self._fetch_np(g, "rkfin_np", g.rkfin_dev)
+        if not self._csum_ok(call, g, buf):
+            return None     # corrupted readback: caught before decode
         import time as _time
-        indptr, dep_rows, _, dbound = buf
+        indptr, dep_rows, _, dbound, _ = buf
         ns = len(g.rk_slots)
         t0 = _time.perf_counter()
         pol = self._outcap(g.arena, "rkey")
@@ -2936,6 +3088,14 @@ class BatchDepsResolver(DepsResolver):
         when no snapshot survived (counted; not expected)."""
         from accord_tpu.primitives.deps import KeyDeps
         results: List[Optional[Deps]] = [None] * len(call.items)
+        if call.degraded:
+            # the dispatch was given up on (launch-retry exhaustion or a
+            # wedged in-flight call): never touch its device buffers --
+            # every item answers through the host differential path,
+            # bit-identical to the device decode
+            return [item.store.host_calculate_deps(
+                        item.txn_id, item.owned, item.before)
+                    for item in call.items]
         for g in call.groups:
             arena = g.arena
             idx = np.asarray(g.idx, np.int64)
@@ -2960,6 +3120,10 @@ class BatchDepsResolver(DepsResolver):
                     kds = self._materialize_finalized(call, g)
                 if kds is not None:
                     self.finalized_decodes += 1
+                    if call.canary and g.fin_mat is None:
+                        # probation: check the finalized decode against
+                        # the legacy decode of the same plan-time snapshot
+                        self._canary_check(call, g, kds)
             if kds is None and has_pk:
                 if g.fin_slots is not None:
                     self.finalize_fallbacks += 1
@@ -3111,6 +3275,18 @@ class BatchDepsResolver(DepsResolver):
                 groups.append(g)
             g.idx.append(i)
             g.items.append(item)
+        health = self._health.get(id(node))
+        if health is not None and health.route_host:
+            # quarantine reroute: every item answers through the host
+            # differential path (bit-identical to the device decode) at
+            # the normal harvest event -- no encode, no pins, no device
+            # call. The countdown below eventually re-enters the device
+            # path on probation.
+            for item in items:
+                item.fallback = "full"
+            self.degraded_dispatches += 1
+            health.on_host_dispatch()
+            return _Plan(items, groups, empty=True)
         if all(g.arena.count == 0 and g.arena.ranges.count == 0
                for g in groups):
             # nothing on device to conflict with (and possibly no encoder
@@ -3137,18 +3313,63 @@ class BatchDepsResolver(DepsResolver):
         if plan.empty:
             call = _Call(None, None, None, plan.items, plan.groups, did=did)
         else:
-            t0 = _time.perf_counter()
-            packed, rpacked, kpacked = self._run_plan(plan)
-            call = _Call(packed, rpacked, kpacked, plan.items, plan.groups,
-                         plan.want, did=did)
-            for _, _, dev in call.buffers():
-                _dev_copy_async(dev)
-            dt = _time.perf_counter() - t0
-            self.dispatch_s += dt
-            if REC.enabled:
-                REC.complete(node_pid(node), "device", "launch",
-                             node_ts(node), dur=round(dt * 1e6, 3),
-                             args={"did": did})
+            from accord_tpu.ops import fault_plane
+            plane = fault_plane.ACTIVE
+            fault = plane.draw() if plane is not None else None
+            degraded = False
+            if fault == "dispatch_exc":
+                # simulated kernel-launch failure burst: bounded retries
+                # (host wall time only -- the harvest event keeps its sim
+                # offset, so handling is timing-neutral); a burst past the
+                # retry limit gives the dispatch up to the host path
+                plane.note("dispatch_exc")
+                self.device_faults_injected += 1
+                fails = plane.draw_burst()
+                self.device_retries += min(fails, self.retry_limit)
+                if fails > self.retry_limit:
+                    degraded = True
+                    self._node_health(node).on_fault("dispatch_exc")
+                    if REC.enabled:
+                        REC.instant(node_pid(node), "device",
+                                    "dispatch_gave_up", node_ts(node),
+                                    args={"did": did, "fails": fails})
+            if degraded:
+                for item in plan.items:
+                    item.fallback = "full"
+                call = _Call(None, None, None, plan.items, plan.groups,
+                             did=did)
+                call.degraded = True
+                call.faulted = True
+                self.degraded_dispatches += 1
+            else:
+                t0 = _time.perf_counter()
+                packed, rpacked, kpacked = self._run_plan(plan)
+                call = _Call(packed, rpacked, kpacked, plan.items,
+                             plan.groups, plan.want, did=did)
+                for _, _, dev in call.buffers():
+                    _dev_copy_async(dev)
+                dt = _time.perf_counter() - t0
+                self.dispatch_s += dt
+                if fault == "stuck":
+                    plane.note("stuck")
+                    self.device_faults_injected += 1
+                    call.stuck_left = plane.draw_stuck()
+                elif fault == "corrupt":
+                    # applied (and counted) at harvest, once the host
+                    # copies exist -- dropped if no finalized lane rode
+                    # this call
+                    call.corrupt_pending = True
+                elif fault == "overflow":
+                    # consumed at materialize: the finalize result reports
+                    # an out-cap overflow, driving the OutCapTiers bump
+                    call.overflow_pending = True
+                health = self._health.get(id(node))
+                if health is not None and health.wants_canary:
+                    call.canary = True
+                if REC.enabled:
+                    REC.complete(node_pid(node), "device", "launch",
+                                 node_ts(node), dur=round(dt * 1e6, 3),
+                                 args={"did": did})
         self.dispatches += 1
         if staged:
             self.staged_dispatches += 1
@@ -3234,7 +3455,26 @@ class BatchDepsResolver(DepsResolver):
             return  # defensive: every dispatch schedules exactly one harvest
         call = q.popleft()
         stalled = False
-        if call.has_device:
+        if call.has_device and call.stuck_left:
+            # harvest watchdog, deterministic half: an injected stuck call
+            # eats not-ready probes; within the probe budget it completes
+            # late (counted as retries), past it the call is declared
+            # wedged and the whole dispatch answers host-side. Probes are
+            # host-wall work inside this one harvest event, so sim timing
+            # (and therefore the committed history) is unchanged.
+            probes = min(call.stuck_left, self.watchdog_probes)
+            self.device_retries += probes
+            call.stuck_left -= probes
+            if call.stuck_left > 0:
+                self.device_watchdog_trips += 1
+                self._node_health(node).on_fault("stuck")
+                call.degraded = True
+                for item in call.items:
+                    item.fallback = "full"
+                if REC.enabled:
+                    REC.instant(node_pid(node), "device", "watchdog_trip",
+                                node_ts(node), args={"did": call.did})
+        if call.has_device and not call.degraded:
             t0 = _time.perf_counter()
             stalled = call.fetch()
             ft = _time.perf_counter() - t0
@@ -3243,6 +3483,19 @@ class BatchDepsResolver(DepsResolver):
                 self.harvest_stall_s += ft
             else:
                 self.prefetched += 1
+            if self.watchdog_wall_s is not None \
+                    and ft > self.watchdog_wall_s:
+                # wall half (real devices): a transfer past the budget is
+                # a late completion -- results are still used (checksum
+                # still guards them) but the ladder records the fault
+                self.device_watchdog_trips += 1
+                call.faulted = True
+                self._node_health(node).on_fault("late")
+            if call.corrupt_pending:
+                from accord_tpu.ops import fault_plane
+                if fault_plane.ACTIVE is not None:
+                    self._apply_corruption(call, fault_plane.ACTIVE)
+                call.corrupt_pending = False
         if REC.enabled:
             REC.async_end(node_pid(node), "device", "window",
                           f"d{call.did}", node_ts(node), local=True,
@@ -3272,6 +3525,12 @@ class BatchDepsResolver(DepsResolver):
             REC.complete(node_pid(node), "device", "decode", node_ts(node),
                          dur=round(dt * 1e6, 3),
                          args={"hidden": bool(q), "did": call.did})
+        health = self._health.get(id(node))
+        if health is not None and call.has_device and not call.degraded \
+                and not call.faulted:
+            # a fully clean device harvest walks DEGRADED back toward
+            # HEALTHY (and counts probation canaries via _canary_check)
+            health.on_clean_dispatch()
         for item, deps in zip(call.items, results):
             if item.outcome is not None:
                 item.out.try_set_success((item.outcome, item.before, deps))

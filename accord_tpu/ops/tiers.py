@@ -24,12 +24,20 @@ computed bound that rides back with each finalize result:
   * overflow: an observed `indptr[-1] > out_cap` bumps to the next rung
     right away, so at most one dispatch pays the fallback.
 
-Estimates scale the last observed per-slot mean bound by the current slot
-count and add `headroom` (a >>3 fractional pad, floored at `headroom_min`)
-to absorb the staleness of riding one in-flight window behind the truth.
+Estimates are WINDOWED: each dispatch's device bound lands in a rolling
+window of the last `window` observations, and the estimate projects the
+window's HIGH-WATER per-slot ratio onto the current slot count (plus
+`headroom`, a >>3 fractional pad floored at `headroom_min`, absorbing the
+staleness of riding one in-flight window behind the truth). High-water --
+not last-value -- is what keeps bursty mixes stable: one overflow storm
+bumps the tier once, and the storm's bound then holds the estimate up for
+a full window, so quiet dispatches in between cannot oscillate the pinned
+tier back down and re-trip the overflow (shrink hysteresis still applies
+on top, after `shrink_after` consecutive below-tier estimates).
 """
 from __future__ import annotations
 
+from collections import deque
 from typing import Callable, Optional, Tuple
 
 
@@ -56,11 +64,11 @@ class OutCapTiers:
 
     __slots__ = ("tiers", "floor", "shrink_after", "headroom_shift",
                  "headroom_min", "on_switch", "current", "switches",
-                 "_mean_num", "_mean_den", "_below")
+                 "_window", "_below")
 
     def __init__(self, tiers: Tuple[int, ...], floor: int,
                  shrink_after: int = 6, headroom_shift: int = 3,
-                 headroom_min: int = 64,
+                 headroom_min: int = 64, window: int = 16,
                  on_switch: Optional[Callable[[], None]] = None):
         self.tiers = tiers
         self.floor = floor
@@ -70,29 +78,31 @@ class OutCapTiers:
         self.on_switch = on_switch
         self.current: Optional[int] = None
         self.switches = 0
-        self._mean_num = 0
-        self._mean_den = 0
+        # rolling (bound, slots) observations; estimates project the
+        # window's high-water per-slot ratio, so a burst's bound keeps the
+        # estimate (and the pinned tier) up for `window` dispatches
+        self._window: "deque[Tuple[int, int]]" = deque(maxlen=max(1, window))
         self._below = 0
 
     @property
     def cold(self) -> bool:
         """True until the first device bound has been observed -- the one
         dispatch where the caller must seed with its host-exact bound."""
-        return self._mean_den == 0
+        return not self._window
 
     def observe(self, bound: int, slots: int) -> None:
         """Record a dispatch's (device-computed) bound over `slots` CSR
-        slots; the next estimate scales this per-slot mean."""
-        self._mean_num = int(bound)
-        self._mean_den = max(int(slots), 1)
+        slots into the rolling window."""
+        self._window.append((int(bound), max(int(slots), 1)))
 
     def estimate(self, slots: int) -> Optional[int]:
-        """Projected bound for a dispatch of `slots` slots, with headroom;
-        None while cold (no observation to scale)."""
-        if self._mean_den == 0:
+        """Projected bound for a dispatch of `slots` slots: the window
+        high-water of each observation's per-slot ratio scaled to `slots`,
+        plus headroom; None while cold (no observation to scale)."""
+        if not self._window:
             return None
-        base = (self._mean_num * max(int(slots), 1)
-                + self._mean_den - 1) // self._mean_den
+        s = max(int(slots), 1)
+        base = max((num * s + den - 1) // den for num, den in self._window)
         pad = max(base >> self.headroom_shift, self.headroom_min)
         return base + pad
 

@@ -452,8 +452,11 @@ def sharded_finalize_csr(mesh: Mesh):
          elsewhere) outside the shard_map body, in the same jit.
 
     Word order equals row order and shards partition words contiguously,
-    so (indptr, dep_rows, dep_ts, bound) is bit-identical to the
-    single-device finalize_csr. Overflow keeps the same contract
+    so (indptr, dep_rows, dep_ts, bound, csum) is bit-identical to the
+    single-device finalize_csr -- the csr_checksum integrity word is
+    computed over the MERGED triple, after the fragment sum, so it folds
+    exactly the arrays the harvest will read back. Overflow keeps the
+    same contract
     (indptr[-1] > out_cap; the exact total comes from the gathered counts,
     never from the possibly-dropped scatters). lru_cached by mesh: every
     resolver on the mesh shares one compiled kernel per (shape, out_cap)."""
@@ -535,7 +538,9 @@ def sharded_finalize_csr(mesh: Mesh):
         dep_rows = jnp.sum(frags, axis=0)
         bound = jnp.sum(bounds, dtype=jnp.int32)
         dep_ts = act_ts[dep_rows]
-        return indptr, dep_rows, dep_ts, bound
+        from accord_tpu.ops.kernels import csr_checksum
+        return (indptr, dep_rows, dep_ts, bound,
+                csr_checksum(indptr, dep_rows, dep_ts))
 
     return jax.jit(run, static_argnames=("out_cap",))
 

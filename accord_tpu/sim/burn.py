@@ -10,6 +10,9 @@ convergence and final-state consistency at quiescence.
 CLI:  python -m accord_tpu.sim.burn --seed 1 --ops 1000 [--nodes 3]
       [--count K]  run K consecutive seeds
       [--reconcile] run each seed twice and require identical event logs
+      [--device-chaos] device resolvers + seeded device-plane fault
+                       injection (dispatch exceptions, stuck harvests,
+                       corrupted readbacks, overflow storms)
 """
 from __future__ import annotations
 
@@ -47,11 +50,17 @@ class BurnReport:
         # end of the run (txn latency histograms, resolver counters); bench
         # JSON reads its snapshot()
         self.registry = None
+        # per-kind device-plane injection counts when --device-chaos ran
+        # (ops/fault_plane.py), else None
+        self.device_faults: Optional[Dict[str, int]] = None
 
     def as_dict(self) -> dict:
-        return {"acked": self.acked, "failed": self.failed, "lost": self.lost,
-                "events": self.events, "elapsed_sim_ms": self.elapsed_sim_ms,
-                "counters": dict(self.counters)}
+        d = {"acked": self.acked, "failed": self.failed, "lost": self.lost,
+             "events": self.events, "elapsed_sim_ms": self.elapsed_sim_ms,
+             "counters": dict(self.counters)}
+        if self.device_faults is not None:
+            d["device_faults"] = dict(self.device_faults)
+        return d
 
 
 def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
@@ -64,12 +73,26 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
              crash_restart: bool = False, crash_down_ms: float = 800.0,
              range_read_ratio: float = 0.0, range_write_ratio: float = 0.0,
              max_range_width: int = 2048,
+             device_chaos: bool = False,
+             device_fault_rates: Optional[Dict[str, float]] = None,
              config: Optional[ClusterConfig] = None,
              collect_log: bool = False) -> BurnReport:
     cfg = config or ClusterConfig(num_nodes=nodes, rf=rf)
     cluster = Cluster(seed, cfg)
     wl_rng = cluster.rng.fork()
     chaos_rng = cluster.rng.fork()
+    # forked UNCONDITIONALLY so every later fork (churn, crash) stays
+    # stream-aligned between a chaos run and the fault-free run of the same
+    # seed -- the bit-identical-history comparison depends on it
+    dev_rng = cluster.rng.fork()
+    plane = None
+    if device_chaos:
+        from accord_tpu.ops.fault_plane import DeviceFaultPlane
+        rates = device_fault_rates if device_fault_rates is not None else {
+            "dispatch_exc_rate": 0.03, "stuck_rate": 0.03,
+            "corrupt_rate": 0.03, "overflow_rate": 0.01,
+        }
+        plane = DeviceFaultPlane(dev_rng, **rates)
     verifier = StrictSerializabilityVerifier()
     report = BurnReport()
     state = {"submitted": 0, "completed": 0, "next_value": 1}
@@ -287,7 +310,13 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
     for i in range(min(concurrency, ops)):
         cluster.queue.add(wl_rng.next_int(20_000), submit)
 
-    report.events = cluster.drain(max_events=ops * 20000)
+    if plane is not None:
+        from accord_tpu.ops import fault_plane
+        with fault_plane.scoped(plane):
+            report.events = cluster.drain(max_events=ops * 20000)
+        report.device_faults = dict(plane.injected)
+    else:
+        report.events = cluster.drain(max_events=ops * 20000)
     report.elapsed_sim_ms = (cluster.queue.now_micros - 1_000_000) / 1000.0
     report.lost = state["submitted"] - state["completed"]
 
@@ -319,6 +348,10 @@ def main(argv=None) -> int:
     ap.add_argument("--rf", type=int, default=3)
     ap.add_argument("--keys", type=int, default=32)
     ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--zipf-theta", type=float, default=0.0,
+                    help="skew key picks toward the hot-set head (0 = uniform)")
+    ap.add_argument("--ephemeral-read-ratio", type=float, default=0.0,
+                    help="fraction of txns issued as single-key ephemeral reads")
     ap.add_argument("--chaos-drop", type=float, default=0.0,
                     help="max per-link drop probability (re-randomized every 2s)")
     ap.add_argument("--range-read-ratio", type=float, default=0.0)
@@ -330,24 +363,52 @@ def main(argv=None) -> int:
     ap.add_argument("--churn-interval-ms", type=float, default=1000.0)
     ap.add_argument("--crash-restart", action="store_true",
                     help="crash+restart each node once (journal replay)")
+    ap.add_argument("--crash-down-ms", type=float, default=800.0,
+                    help="simulated downtime before a crashed node restarts")
+    ap.add_argument("--device-chaos", action="store_true",
+                    help="device resolvers + seeded device-plane fault "
+                         "injection (see ops/fault_plane.py)")
     ap.add_argument("--reconcile", action="store_true",
                     help="run each seed twice; require identical logs")
     args = ap.parse_args(argv)
+
+    config_factory = None
+    if args.device_chaos:
+        # the injected faults land on the DEVICE dispatch path, so the run
+        # needs device resolvers; a fresh config per run keeps --reconcile
+        # legs from sharing resolver state
+        from accord_tpu.ops.resolver import BatchDepsResolver
+        from accord_tpu.sim.cluster import ClusterConfig as _CC
+
+        def config_factory():
+            return _CC(
+                num_nodes=args.nodes, rf=args.rf,
+                deps_resolver_factory=lambda: BatchDepsResolver(
+                    num_buckets=128),
+                deps_batch_window_ms=2.0, device_latency_ms=8.0)
 
     ok = True
     for seed in range(args.seed, args.seed + args.count):
         kwargs = dict(ops=args.ops, nodes=args.nodes, rf=args.rf,
                       key_count=args.keys, concurrency=args.concurrency,
+                      zipf_theta=args.zipf_theta,
+                      ephemeral_read_ratio=args.ephemeral_read_ratio,
                       chaos_drop=args.chaos_drop,
                       range_read_ratio=args.range_read_ratio,
                       range_write_ratio=args.range_write_ratio,
                       chaos_partitions=args.chaos_partitions,
                       topology_churn=args.topology_churn,
                       churn_interval_ms=args.churn_interval_ms,
-                      crash_restart=args.crash_restart)
+                      crash_restart=args.crash_restart,
+                      crash_down_ms=args.crash_down_ms,
+                      device_chaos=args.device_chaos)
         try:
+            if config_factory is not None:
+                kwargs["config"] = config_factory()
             r = run_burn(seed, collect_log=args.reconcile, **kwargs)
             if args.reconcile:
+                if config_factory is not None:
+                    kwargs["config"] = config_factory()
                 r2 = run_burn(seed, collect_log=True, **kwargs)
                 if r.log != r2.log:
                     print(f"seed {seed}: NON-DETERMINISTIC ({len(r.log)} vs {len(r2.log)} entries)")

@@ -708,6 +708,146 @@ def bench_range_mix(quick: bool):
 
 
 # ---------------------------------------------------------------------------
+# 2b2. device chaos: fault-injected device plane, bit-identical + bounded dip
+# ---------------------------------------------------------------------------
+
+CHAOS_RATES = {"dispatch_exc_rate": 0.06, "stuck_rate": 0.06,
+               "corrupt_rate": 0.06, "overflow_rate": 0.02}
+
+
+def bench_device_chaos(quick: bool):
+    """Contended device-resolver burn under seeded device-plane fault
+    injection (ops/fault_plane.py): dispatch exceptions, stuck harvests,
+    corrupted readbacks, out-cap overflow storms. Proves the hardening
+    claims end to end: every corrupted harvest is caught by the checksum
+    lane before decode, the health ladder quarantines AND recovers nodes
+    (probation canaries re-enter the device path against warmed tiers, so
+    the measured leg mints zero compiles), two chaos runs reconcile
+    bit-identically, the fault-free run of the same seed commits the SAME
+    history, and the chaos throughput dip stays bounded."""
+    from accord_tpu.ops.kernels import jit_cache_sizes
+    from accord_tpu.ops.resolver import BatchDepsResolver
+    from accord_tpu.sim.burn import run_burn
+    from accord_tpu.sim.cluster import ClusterConfig
+
+    ops = 150 if quick else 400
+
+    def leg(chaos: bool):
+        resolvers = []
+
+        def factory():
+            r = BatchDepsResolver(num_buckets=E2E_BUCKETS,
+                                  initial_cap=E2E_ARENA_CAP,
+                                  max_dispatch=256)
+            resolvers.append(r)
+            return r
+
+        cfg = ClusterConfig(
+            num_nodes=5, rf=3,
+            deps_resolver_factory=factory,
+            deps_batch_window_ms=2.0, device_latency_ms=8.0,
+            durability=True, durability_interval_ms=1000.0,
+            timeout_ms=8000.0, preaccept_timeout_ms=8000.0,
+            progress_stall_ms=5000.0)
+        t0 = time.perf_counter()
+        rep = run_burn(31, ops=ops, key_count=HOT_KEYS, zipf_theta=0.99,
+                       write_ratio=0.7, device_chaos=chaos,
+                       device_fault_rates=CHAOS_RATES if chaos else None,
+                       collect_log=True, config=cfg)
+        return time.perf_counter() - t0, rep, resolvers
+
+    wall_a, rep_a, res_a = leg(True)      # warm + reconcile reference
+    cache0 = jit_cache_sizes()
+    wall_b, rep_b, res_b = leg(True)      # measured chaos leg
+    cache1 = jit_cache_sizes()
+    wall_c, rep_c, _ = leg(False)         # fault-free, same seed
+    if rep_a.log != rep_b.log:
+        raise AssertionError("chaos burn is not reconcile-identical")
+    if rep_b.log != rep_c.log:
+        raise AssertionError(
+            "chaos burn's committed history diverged from the fault-free "
+            "run of the same seed")
+    if rep_b.lost:
+        raise AssertionError(f"chaos burn lost {rep_b.lost} acked txns")
+    # probation canaries re-enter the device path against tiers the burn
+    # already warmed: recovery mints zero compiles (kid-table dirty-word
+    # buckets exempt as in bench_e2e -- data-tiered, once-ever)
+    drift = {k: (cache0.get(k, 0), v) for k, v in cache1.items()
+             if v != cache0.get(k, 0) and k != "kid_word_scatter"}
+    if drift:
+        raise AssertionError(
+            f"jit tiers compiled inside the measured chaos leg: {drift}")
+
+    def agg(name):
+        return sum(getattr(r, name) for r in res_b)
+
+    injected = rep_b.device_faults
+    total = sum(injected.values())
+    if agg("device_faults_injected") != total:
+        raise AssertionError(
+            f"injection ledger mismatch: plane says {total}, resolvers "
+            f"counted {agg('device_faults_injected')}")
+    if any(injected[k] == 0 for k in injected):
+        raise AssertionError(f"a fault kind never fired: {injected}")
+    # every corrupted readback caught by the checksum lane before decode
+    if agg("checksum_mismatches") != injected["corrupt"]:
+        raise AssertionError(
+            f"checksum lane missed corruption: {injected['corrupt']} "
+            f"injected, {agg('checksum_mismatches')} caught")
+    if agg("device_watchdog_trips") == 0:
+        raise AssertionError("no stuck call ever tripped the watchdog")
+    # the health ladder must complete full quarantine round trips:
+    # entries AND exits (probation canaries passing)
+    if agg("quarantine_entries") == 0 or agg("quarantine_exits") < 1:
+        raise AssertionError(
+            f"quarantine ladder did not round-trip: "
+            f"{agg('quarantine_entries')} entries, "
+            f"{agg('quarantine_exits')} exits")
+    # overflow storms bump the windowed OutCapTiers once each, not per
+    # quiet dispatch in between: switch count stays near the storm count
+    switches = agg("outcap_tier_switches")
+    if switches > 2 * injected["overflow"] + 8:
+        raise AssertionError(
+            f"out-cap tier flapping: {switches} switches for "
+            f"{injected['overflow']} overflow storms")
+    # bounded throughput dip: chaos pays retries/host reroutes, not a
+    # collapse (loose wall gate -- CI machines are noisy)
+    dip = wall_b / max(wall_c, 1e-9)
+    if dip > 3.0:
+        raise AssertionError(
+            f"chaos leg {wall_b:.1f}s vs fault-free {wall_c:.1f}s "
+            f"(x{dip:.2f}): dip not bounded")
+    dispatches = agg("dispatches")
+    degraded = agg("degraded_dispatches")
+    if dispatches and degraded > dispatches // 2:
+        raise AssertionError(
+            f"{degraded}/{dispatches} dispatches degraded to host: the "
+            f"device plane effectively fell over")
+    return {
+        "ops": ops,
+        "rates": CHAOS_RATES,
+        "acked": rep_b.acked,
+        "failed": rep_b.failed,
+        "injected": dict(injected),
+        "wall_s": {"chaos": round(wall_b, 1), "fault_free": round(wall_c, 1),
+                   "warm": round(wall_a, 1)},
+        "throughput_dip": round(dip, 2),
+        "reconcile_identical": True,
+        "history_identical_to_fault_free": True,
+        "dispatches": dispatches,
+        "degraded_dispatches": degraded,
+        "device_retries": agg("device_retries"),
+        "device_watchdog_trips": agg("device_watchdog_trips"),
+        "checksum_mismatches": agg("checksum_mismatches"),
+        "quarantine_entries": agg("quarantine_entries"),
+        "quarantine_exits": agg("quarantine_exits"),
+        "device_canaries": agg("device_canaries"),
+        "outcap_tier_switches": switches,
+        "finalized_decodes": agg("finalized_decodes"),
+    }
+
+
+# ---------------------------------------------------------------------------
 # 2c. pad_store_tiers: fixed fused jit tier across participating-store counts
 # ---------------------------------------------------------------------------
 
@@ -1079,6 +1219,8 @@ def main(argv=None) -> int:
         # whole-leg wrapper would mix three burns into one stream)
         e2e = bench_e2e(args.quick)
         range_mix = _traced("range_mix", bench_range_mix, args.quick)
+        device_chaos = _traced("device_chaos", bench_device_chaos,
+                               args.quick)
         pad_tiers = _traced("pad_tiers", bench_pad_tiers, args.quick)
         exec_plane = _traced("exec_plane", bench_exec_plane, args.quick)
 
@@ -1095,6 +1237,7 @@ def main(argv=None) -> int:
                 "maelstrom": maelstrom,
                 "e2e_contended": e2e,
                 "range_mix": range_mix,
+                "device_chaos": device_chaos,
                 "pad_store_tiers": pad_tiers,
                 "exec_plane": exec_plane,
                 "obs_overhead": obs_overhead,
