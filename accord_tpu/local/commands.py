@@ -464,6 +464,10 @@ def notify_listeners(store: CommandStore, cmd: Command) -> None:
     plane = store.exec_plane
     if plane is not None:
         plane.on_status(cmd)
+    if store.cmd_plane is not None:
+        # keep the device command arena's row lanes tracking host-side
+        # transitions (recovery, invalidation, durability merges)
+        store.cmd_plane.on_status(cmd)
     terminal = cmd.is_(Status.INVALIDATED) or cmd.is_(Status.TRUNCATED)
     if cmd.waiters and (terminal or cmd.known_execute_at):
         d = cmd.txn_id

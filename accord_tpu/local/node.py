@@ -245,7 +245,8 @@ class Node:
         if self.command_stores is not None:
             for store in self.command_stores.all():
                 for obj in (store.deps_resolver,
-                            getattr(store, "exec_plane", None)):
+                            getattr(store, "exec_plane", None),
+                            getattr(store, "cmd_plane", None)):
                     if obj is None or id(obj) in seen:
                         continue
                     seen.add(id(obj))

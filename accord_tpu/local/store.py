@@ -73,6 +73,7 @@ class CommandStore:
                              else _NoopProgressLog())
         self.deps_resolver = deps_resolver  # None -> host scan below
         self.exec_plane = None              # optional device exec scheduler
+        self.cmd_plane = None               # optional device command arena
         # micro-batch coalescing window for the async device path (resolver
         # owns the per-NODE tick, which fuses EVERY store's pending items
         # into one cross-store dispatch; see ops/resolver.BatchDepsResolver):
@@ -232,6 +233,9 @@ class CommandStore:
                 prev = by_key.get(k)
                 if prev is None or ts > prev:
                     by_key[k] = ts
+            if self.cmd_plane is not None:
+                # keep the device kmax lanes tracking the host fold
+                self.cmd_plane.on_max_conflict(seekables, ts)
         else:
             for r in seekables:
                 self.max_conflicts = self.max_conflicts.with_range(
@@ -801,14 +805,55 @@ class CommandStore:
                                           ballot)
 
     def _preaccept_now(self, txn_id, partial_txn, route, ballot):
-        from accord_tpu.local import commands
-        outcome = commands.preaccept(self, txn_id, partial_txn, route, ballot)
         from accord_tpu.local.commands import AcceptOutcome
+        if self.cmd_plane is not None:
+            from accord_tpu.ops.cmd_plane import CmdOp
+            outcome = self.cmd_plane.eval_batch(
+                [CmdOp.preaccept(txn_id, partial_txn, route,
+                                 ballot)])[0].outcome
+        else:
+            from accord_tpu.local import commands
+            outcome = commands.preaccept(self, txn_id, partial_txn, route,
+                                         ballot)
         if outcome in (AcceptOutcome.REJECTED_BALLOT, AcceptOutcome.TRUNCATED):
             return (outcome, None, None)
         witnessed = self.command(txn_id).execute_at
         deps = self.calculate_deps(txn_id, self.owned(partial_txn.keys), witnessed)
         return (outcome, witnessed, deps)
+
+    # -- command-plane transition routing ------------------------------------
+    # Accept/Commit/Apply transitions route through the device command arena
+    # (ops/cmd_plane.py) when one is attached; the Python handlers otherwise.
+    # Single-op batches here; coordinators that hold several transitions for
+    # one store (the resolver drain, the bench) call eval_batch directly.
+    def accept_op(self, txn_id, ballot, route, keys, execute_at, deps=None):
+        if self.cmd_plane is not None:
+            from accord_tpu.ops.cmd_plane import CmdOp
+            return self.cmd_plane.eval_batch(
+                [CmdOp.accept(txn_id, ballot, route, keys, execute_at,
+                              deps)])[0].outcome
+        from accord_tpu.local import commands
+        return commands.accept(self, txn_id, ballot, route, keys,
+                               execute_at, deps)
+
+    def commit_op(self, txn_id, route, txn, execute_at, deps):
+        if self.cmd_plane is not None:
+            from accord_tpu.ops.cmd_plane import CmdOp
+            return self.cmd_plane.eval_batch(
+                [CmdOp.commit(txn_id, route, txn, execute_at,
+                              deps)])[0].outcome
+        from accord_tpu.local import commands
+        return commands.commit(self, txn_id, route, txn, execute_at, deps)
+
+    def apply_op(self, txn_id, route, txn, execute_at, deps, writes, result):
+        if self.cmd_plane is not None:
+            from accord_tpu.ops.cmd_plane import CmdOp
+            return self.cmd_plane.eval_batch(
+                [CmdOp.apply(txn_id, route, txn, execute_at, deps, writes,
+                             result)])[0].outcome
+        from accord_tpu.local import commands
+        return commands.apply(self, txn_id, route, txn, execute_at, deps,
+                              writes, result)
 
     def host_range_deps(self, txn_id: TxnId, seekables: Seekables,
                         before: Timestamp) -> Deps:

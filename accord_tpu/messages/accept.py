@@ -33,7 +33,7 @@ class Accept(Request):
             return
 
         def one_store(store):
-            outcome = commands.accept(store, self.txn_id, self.ballot, self.route,
+            outcome = store.accept_op(self.txn_id, self.ballot, self.route,
                                       store.owned(self.keys), self.execute_at,
                                       self.deps)
             if outcome == AcceptOutcome.REJECTED_BALLOT:

@@ -31,7 +31,7 @@ class Apply(Request):
     def process(self, node, from_node, reply_context) -> None:
         def map_fn(store):
             partial = self.txn.slice(store.ranges, include_query=False)
-            commands.apply(store, self.txn_id, self.route, partial,
+            store.apply_op(self.txn_id, self.route, partial,
                            self.execute_at, self.deps,
                            self.writes.slice(store.ranges) if self.writes else None,
                            self.result)

@@ -31,7 +31,7 @@ class Commit(Request):
 
         def map_fn(store):
             partial = self.txn.slice(store.ranges, include_query=False)
-            commands.commit(store, self.txn_id, self.route, partial,
+            store.commit_op(self.txn_id, self.route, partial,
                             self.execute_at, self.deps)
             return CommitOk(self.txn_id)
 

@@ -355,6 +355,14 @@ GLOSSARY: Dict[str, str] = {
     "exec_coord.fused_dispatches": "frontier dispatches that fused >1 store",
     "exec_coord.harvest_stall_s": "wall seconds the coordinator blocked on readbacks",
     "exec_coord.prefetched": "coordinator readbacks drained early by the poll",
+    # -- device coordination plane (CmdPlane.metrics) ------------------------
+    "cmd_plane_dispatches": "batched cmd_tick kernel dispatches",
+    "cmd_plane_upload_bytes": "cmd-arena lane bytes shipped host->device",
+    "cmd_fastpath_device_evals": "protocol ops evaluated on-device",
+    "cmd_plane_fallbacks": "inadmissible ops replayed by host handlers",
+    "cmd_plane_checksum_mismatches": "cmd harvests rejected by the checksum lane",
+    "cmd_plane_compactions": "cmd-arena compaction passes (generation bumps)",
+    "cmd_plane_flush_s": "dirty-lane scatter upload wall seconds",
     # -- per-node txn lifecycle (Node.metrics) -------------------------------
     "txn.started": "coordinations started on this node",
     "txn.failed": "coordinations failed (timeout/invalidated)",

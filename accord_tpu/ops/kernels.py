@@ -659,21 +659,29 @@ def range_finalize_csr(iv_of, iv_start, iv_end, ent_ok,
     the targeted store).
 
     -> (indptr i32[NV+1], dep_rows i32[out_cap], dep_ts i32[out_cap, 3],
-        csum u32 scalar -- the csr_checksum integrity word, verified at
-        harvest); dep_ts carries the range arena's txn-id lanes so results
-       are txn ids.
+        bound i32 scalar, csum u32 scalar); dep_ts carries the range
+       arena's txn-id lanes so results are txn ids. `bound` is the
+       segmented STAB COUNT -- the number of (entry, valid-range) interval
+       overlaps before the witness/before narrowing -- an exact upper
+       bound on indptr[-1] read back with the result so the NEXT
+       dispatch's out_cap tier needs no host entries*nvalid product
+       (resolver's OutCapTiers policy, mirroring finalize_csr's key-lane
+       bound from PR 8). `csum` is the csr_checksum integrity word,
+       verified at harvest.
     """
     b = subj_before.shape[0]
     o = jnp.clip(iv_of, 0, b - 1)
     inb = (iv_of >= 0) & (iv_of < b) & ent_ok
     hit = (iv_start[:, None] < r_end[None, :]) \
         & (r_start[None, :] < iv_end[:, None])
+    stab = hit & r_valid[None, :] & inb[:, None]
+    bound = jnp.sum(stab.astype(jnp.int32), dtype=jnp.int32)
     witness = witness_table[subj_kinds[o][:, None], r_kinds[None, :]] == 1
     before = _lex_before(r_ts[None, :, :], subj_before[o][:, None, :])
-    m = hit & witness & before & r_valid[None, :] & inb[:, None]
+    m = stab & witness & before
     indptr, dep_rows = _segment_compact(m.astype(jnp.int32), out_cap)
     dep_ts = r_ts[dep_rows]
-    return (indptr, dep_rows, dep_ts,
+    return (indptr, dep_rows, dep_ts, bound,
             csr_checksum(indptr, dep_rows, dep_ts))
 
 
@@ -798,6 +806,375 @@ def out_tier(n: int) -> int:
     return snap(n, OUT_TIERS, OUT_TIER_FLOOR)
 
 
+# ---------------------------------------------------------------------------
+# Device command plane (ops/cmd_plane.py): batched txn state machines
+# ---------------------------------------------------------------------------
+
+# Status ladder constants mirrored from local.status.Status. ops/cmd_plane.py
+# asserts these against the enum at import, so the mirrors cannot drift.
+CMD_ST_PRE_ACCEPTED = 1
+CMD_ST_ACCEPTED = 3
+CMD_ST_COMMITTED = 5
+CMD_ST_STABLE = 6
+CMD_ST_READY = 7
+CMD_ST_PRE_APPLIED = 8
+CMD_ST_APPLIED = 9
+CMD_ST_INVALIDATED = 10
+CMD_ST_TRUNCATED = 11
+
+# outcome codes in the low 3 bits of out_code (cmd_plane maps them back to
+# AcceptOutcome / CommitOutcome); high bits carry side-channel facts the
+# host residuals need
+CMD_OUT_SUCCESS = 0
+CMD_OUT_REDUNDANT = 1
+CMD_OUT_REJECTED_BALLOT = 2
+CMD_OUT_TRUNCATED = 3
+CMD_OUT_INSUFFICIENT = 4
+CMD_OUT_INCONSISTENT_BIT = 8    # redundant commit/apply with executeAt drift
+CMD_OUT_WAS_STABLE_BIT = 16     # apply arrived on an already-stable command
+
+# op kinds in op_kind
+CMD_OP_PREACCEPT = 0
+CMD_OP_ACCEPT = 1
+CMD_OP_COMMIT = 2
+CMD_OP_APPLY = 3
+
+# op_flags bits (host admission encodes these per op)
+CMD_F_PERMIT_FAST = 1    # ballot == Ballot.ZERO
+CMD_F_EPOCH_OK = 2       # txn_id.epoch >= node.epoch at encode time
+CMD_F_EXPIRED = 4        # preaccept expiry fired (precomputed at encode: a
+                         # pure function of txn hlc + now + agent timeout, so
+                         # the host float compare stays exactly authoritative)
+CMD_F_MSG_HAS_TXN = 8    # the commit/apply message carries a txn body
+CMD_F_VALID = 16         # real op (padding rows leave this clear)
+CMD_F_DEPS_EMPTY = 32    # commit/apply deps empty (promote-eligible)
+
+# batched-op padding ladder for cmd_tick dispatches
+CMD_OP_TIERS = (8, 64, 512)
+
+
+def cmd_op_tier(n: int) -> int:
+    """Padded op count for a cmd_tick dispatch carrying n ops."""
+    return snap(n, CMD_OP_TIERS, 4096)
+
+
+def _lex_max_masked(rows, valid):
+    """Lexicographic max over rows[i] where valid[i]. rows: i32[K, 3],
+    valid: bool[K] -> (i32[3] max lanes, bool any_valid); lanes are INT32_MIN
+    when nothing is valid."""
+    neg = jnp.int32(np.iinfo(np.int32).min)
+    l0 = jnp.where(valid, rows[:, 0], neg)
+    m0 = jnp.max(l0)
+    t0 = valid & (rows[:, 0] == m0)
+    l1 = jnp.where(t0, rows[:, 1], neg)
+    m1 = jnp.max(l1)
+    t1 = t0 & (rows[:, 1] == m1)
+    m2 = jnp.max(jnp.where(t1, rows[:, 2], neg))
+    return jnp.stack([m0, m1, m2]), jnp.any(valid)
+
+
+def cmd_checksum(out_code, out_status, out_ts, clock):
+    """Device integrity word over a cmd_tick result block (the PR 11 harvest
+    checksum discipline extended to the command plane): recomputed from the
+    host copies at harvest; a bit-flipped readback falls back to the host
+    handlers instead of applying corrupt transitions."""
+    return (_csum_fold(out_code, 3) ^ _csum_fold(out_status, 7)
+            ^ _csum_fold(out_ts, 11)
+            ^ _csum_fold(clock.reshape(1), 13))
+
+
+def cmd_checksum_host(out_code, out_status, out_ts, clock) -> int:
+    """numpy twin of cmd_checksum; must track the device fold bit for bit."""
+    def fold(x, seed):
+        v = np.ascontiguousarray(x, dtype=np.int32).view(np.uint32).reshape(-1)
+        v = v ^ (v >> np.uint32(16))
+        idx = np.arange(v.shape[0], dtype=np.uint32)
+        return (v * (np.uint32(2) * idx + np.uint32(seed))).sum(
+            dtype=np.uint32)
+    return int(fold(out_code, 3) ^ fold(out_status, 7) ^ fold(out_ts, 11)
+               ^ fold(np.asarray([clock], dtype=np.int32), 13))
+
+
+@functools.partial(jax.jit, static_argnames=("promote",))
+def cmd_tick(status, flags, promised, accepted, execute_at, durability,
+             kmax, kmax_valid, clock,
+             op_kind, op_row, op_txn, op_ballot, op_exec, op_keys, op_flags,
+             op_now, op_prev, op_rlast, op_kprev, op_klast,
+             node_epoch, lane2_clean, lane2_rej,
+             dur_local, promote: bool = False):
+    """One device dispatch evaluating a batch of protocol transitions IN
+    ORDER over the SoA command arena: PreAccept witness (fast-path test +
+    unique_now twin + expiry), Accept ballot checks, Commit/Apply status
+    promotions -- the per-txn Python state machines of local/commands.py
+    re-expressed as one fori_loop over op slots.
+
+    Arena columns (authoritative device state between dispatches):
+      status:     i32[cap]      Status ladder value
+      flags:      i32[cap]      bit0 = definition recorded (cmd.txn != None)
+      promised:   i32[cap, 3]   promised ballot lanes
+      accepted:   i32[cap, 3]   accepted ballot lanes
+      execute_at: i32[cap, 3]   executeAt lanes (INT32_MIN lanes == None)
+      durability: i32[cap]      Durability ladder value
+      kmax:       i32[kcap, 3]  per-key max-conflict lanes (MaxConflicts)
+      kmax_valid: bool[kcap]    false == no conflict witnessed for the key
+      clock:      i32 scalar    node HLC register (node._last_hlc)
+
+    Ops (padded to CMD_OP_TIERS; lanes are ABSOLUTE base-(0,0) encodings:
+    lane0 epoch, lane1 hlc, lane2 (flags << 16 | node) - 2^31):
+      op_kind:  i32[n]     CMD_OP_*
+      op_row:   i32[n]     arena row of the op's txn
+      op_txn:   i32[n, 3]  TxnId lanes (flags carry kind/domain, so this IS
+                           txn_id.as_timestamp() too)
+      op_ballot: i32[n, 3] ballot lanes
+      op_exec:  i32[n, 3]  proposed/decided executeAt lanes (accept/commit/
+                           apply)
+      op_keys:  i32[n, KPAD] dense kid-table slots of the op's owned keys
+                           (-1 padding)
+      op_flags: i32[n]     CMD_F_* bits
+      op_now:   i32[n]     now_micros at the op's scheduler instant
+      op_prev:  i32[n]     index of the previous op in this batch on the
+                           same row (-1 = none): intra-batch row chains
+      op_rlast: bool[n]    this op is its row's LAST writer in the batch
+      op_kprev: i32[n,KPAD] previous writer of this kid slot, encoded
+                           p * KPAD + s (-1 = none)
+      op_klast: bool[n,KPAD] this (op, slot) is the kid's last writer
+    Scalars: node_epoch; lane2_clean/lane2_rej = the node's lane2 value with
+    flags 0 / REJECTED (precomputed host-side: (flags << 16 | node) - 2^31);
+    dur_local = Durability.LOCAL.
+
+    The loop carries only op-tier-sized state: each op's view of the arena
+    is gathered up front, intra-batch dependencies resolve through the
+    prev-writer links, and the final chain values scatter back ONCE after
+    the loop (last-writer wins). Carrying the cap-sized columns through the
+    fori_loop instead makes XLA's copy insertion duplicate them every
+    iteration -- ~17ms per 512-op dispatch at cap 16384 vs ~1ms this way.
+
+    `promote` (static): additionally run the empty-deps maybe_execute
+    promotion on device (STABLE -> READY_TO_EXECUTE, PRE_APPLIED ->
+    APPLIED + durability merge) -- the arena-only bench mode. With host
+    residuals (apply_to_store) the promotion runs host-side instead.
+
+    -> (updated columns..., out_code i32[n], out_ts i32[n, 3] (witnessed /
+        echoed executeAt), out_status i32[n], csum u32)
+    """
+    cap = status.shape[0]
+    kcap = kmax.shape[0]
+    n, kpad = op_keys.shape
+    neg = jnp.int32(np.iinfo(np.int32).min)
+
+    # per-op arena views before the batch (padding slots clip to row/kid 0
+    # and are masked out of every write by op_rlast/op_klast)
+    rowc = jnp.clip(op_row, 0, cap - 1)
+    st_0 = status[rowc]
+    fl_0 = flags[rowc]
+    pr_0 = promised[rowc]
+    ab_0 = accepted[rowc]
+    ea_0 = execute_at[rowc]
+    du_0 = durability[rowc]
+    kid_0 = jnp.clip(op_keys, 0, kcap - 1)
+    km_0 = kmax[kid_0]          # (n, kpad, 3)
+    kv_0 = kmax_valid[kid_0]    # (n, kpad)
+
+    def body(i, c):
+        (r_st, r_fl, r_pr, r_ab, r_ea, r_du, k_km, k_kv,
+         clock, out_code, out_ts, out_status) = c
+        kind = op_kind[i]
+        valid = (op_flags[i] & CMD_F_VALID) != 0
+        prev = op_prev[i]
+        use_prev = prev >= 0
+        pc = jnp.where(use_prev, prev, 0)
+        st = jnp.where(use_prev, r_st[pc], st_0[i])
+        fl = jnp.where(use_prev, r_fl[pc], fl_0[i])
+        pr = jnp.where(use_prev, r_pr[pc], pr_0[i])
+        ab = jnp.where(use_prev, r_ab[pc], ab_0[i])
+        ea = jnp.where(use_prev, r_ea[pc], ea_0[i])
+        du = jnp.where(use_prev, r_du[pc], du_0[i])
+        txn = op_txn[i]
+        bal = op_ballot[i]
+        oex = op_exec[i]
+        kids = op_keys[i]
+        permit_fast = (op_flags[i] & CMD_F_PERMIT_FAST) != 0
+        epoch_ok = (op_flags[i] & CMD_F_EPOCH_OK) != 0
+        expired = (op_flags[i] & CMD_F_EXPIRED) != 0
+        msg_has_txn = (op_flags[i] & CMD_F_MSG_HAS_TXN) != 0
+        deps_empty = (op_flags[i] & CMD_F_DEPS_EMPTY) != 0
+        now = op_now[i]
+
+        has_txn = (fl & 1) != 0
+        ea_set = ea[0] != neg
+        terminal = (st == CMD_ST_INVALIDATED) | (st == CMD_ST_TRUNCATED)
+        pr_gt_bal = _lex_before(bal, pr)
+        pr_max_bal = jnp.where(_lex_before(pr, bal), bal, pr)
+        term_code = jnp.where(st == CMD_ST_INVALIDATED,
+                              CMD_OUT_REJECTED_BALLOT, CMD_OUT_TRUNCATED)
+
+        # kid-table chain: each slot reads its previous in-batch writer's
+        # post-value, else the pre-batch gather
+        links = op_kprev[i]
+        lv = links >= 0
+        lc = jnp.where(lv, links, 0)
+        lp, ls = lc // kpad, lc % kpad
+        kv_raw = jnp.where(lv, k_kv[lp, ls], kv_0[i])
+        kv = kv_raw & (kids >= 0)
+        km = jnp.where(lv[:, None], k_km[lp, ls], km_0[i])
+        mc, mc_any = _lex_max_masked(km, kv)
+
+        # unique_now twin (local/Node.unique_now): hlc = max(now, clock + 1),
+        # bumped past at_least.hlc; epoch = max(node epoch, at_least.epoch)
+        def unow(al_ep, al_hlc, lane2):
+            h = jnp.maximum(now, clock + 1)
+            h = jnp.where(al_hlc >= h, al_hlc + 1, h)
+            return jnp.stack([jnp.maximum(node_epoch, al_ep), h, lane2]), h
+
+        # -- PreAccept (commands.preaccept) -----------------------------------
+        rej_w, rej_h = unow(txn[0], txn[1], lane2_rej)
+        al = jnp.where(mc_any, mc, txn)
+        slow_w, slow_h = unow(al[0], al[1], lane2_clean)
+        fast = permit_fast & (~mc_any | ~_lex_before(txn, mc)) & epoch_ok
+        witness = jnp.where(expired, rej_w, jnp.where(fast, txn, slow_w))
+        wit_clock = jnp.where(expired, rej_h,
+                              jnp.where(fast, clock, slow_h))
+        pa_blocked = terminal | pr_gt_bal
+        pa_code = jnp.where(
+            terminal, term_code,
+            jnp.where(pr_gt_bal, CMD_OUT_REJECTED_BALLOT,
+                      jnp.where(has_txn & permit_fast, CMD_OUT_REDUNDANT,
+                                CMD_OUT_SUCCESS)))
+        pa_wit = ~pa_blocked & ~has_txn & ~ea_set
+        pa_st = jnp.where(
+            pa_blocked | has_txn, st,
+            jnp.where(ea_set, jnp.maximum(st, CMD_ST_PRE_ACCEPTED),
+                      CMD_ST_PRE_ACCEPTED))
+        pa_fl = jnp.where(pa_blocked, fl, fl | 1)
+        pa_pr = jnp.where(pa_blocked, pr, pr_max_bal)
+        pa_ea = jnp.where(pa_wit, witness, ea)
+        pa_out_ts = jnp.where(pa_wit, witness, ea)
+
+        # -- Accept (commands.accept; the reject_before gate is an admission
+        # precondition, so is_rejected_if_not_preaccepted is always false) ---
+        committed = st >= CMD_ST_COMMITTED
+        ac_code = jnp.where(
+            terminal, term_code,
+            jnp.where(pr_gt_bal | committed,
+                      jnp.where(committed, CMD_OUT_REDUNDANT,
+                                CMD_OUT_REJECTED_BALLOT),
+                      CMD_OUT_SUCCESS))
+        ac_ok = ~terminal & ~pr_gt_bal & ~committed
+        ac_st = jnp.where(ac_ok, CMD_ST_ACCEPTED, st)
+        ac_pr = jnp.where(ac_ok, bal, pr)
+        ac_ab = jnp.where(ac_ok, bal, ab)
+        ac_ea = jnp.where(ac_ok, oex, ea)
+
+        # -- Commit -> STABLE (commands.commit) -------------------------------
+        ea_eq = jnp.all(ea == oex)
+        stable = st >= CMD_ST_STABLE
+        cm_incons = stable & ~terminal & ~ea_eq
+        cm_insuf = ~stable & ~has_txn & ~msg_has_txn
+        cm_ok = ~stable & ~cm_insuf
+        cm_code = jnp.where(
+            stable,
+            CMD_OUT_REDUNDANT + jnp.where(cm_incons,
+                                          CMD_OUT_INCONSISTENT_BIT, 0),
+            jnp.where(cm_insuf, CMD_OUT_INSUFFICIENT, CMD_OUT_SUCCESS))
+        cm_new_st = jnp.int32(CMD_ST_STABLE)
+        if promote:
+            cm_new_st = jnp.where(deps_empty, CMD_ST_READY, CMD_ST_STABLE)
+        cm_st = jnp.where(cm_ok, cm_new_st, st)
+        cm_fl = jnp.where(cm_ok & msg_has_txn, fl | 1, fl)
+        cm_ea = jnp.where(cm_ok, oex, ea)
+        # register at max(executeAt, txnId.as_timestamp()) -- TxnId lanes
+        # carry the flags, so the lane compare IS the host compare
+        cm_regval = jnp.where(_lex_before(oex, txn), txn, oex)
+
+        # -- Apply -> PRE_APPLIED (commands.apply) ----------------------------
+        preapplied = st >= CMD_ST_PRE_APPLIED
+        was_stable = st >= CMD_ST_STABLE
+        ap_incons = preapplied & ~terminal & ~ea_eq
+        ap_insuf = ~preapplied & ~has_txn & ~msg_has_txn
+        ap_ok = ~preapplied & ~ap_insuf
+        ap_code = jnp.where(
+            preapplied,
+            CMD_OUT_REDUNDANT + jnp.where(ap_incons,
+                                          CMD_OUT_INCONSISTENT_BIT, 0),
+            jnp.where(ap_insuf, CMD_OUT_INSUFFICIENT,
+                      CMD_OUT_SUCCESS + jnp.where(
+                          was_stable, CMD_OUT_WAS_STABLE_BIT, 0)))
+        ap_new_st = jnp.int32(CMD_ST_PRE_APPLIED)
+        ap_du = du
+        if promote:
+            ap_new_st = jnp.where(deps_empty, CMD_ST_APPLIED,
+                                  CMD_ST_PRE_APPLIED)
+            ap_du = jnp.where(ap_ok & deps_empty,
+                              jnp.maximum(du, dur_local), du)
+        ap_st = jnp.where(ap_ok, ap_new_st, st)
+        ap_fl = jnp.where(ap_ok & msg_has_txn, fl | 1, fl)
+        ap_ea = jnp.where(ap_ok, oex, ea)
+
+        # -- select per kind, gate on valid, scatter back ---------------------
+        is_pa = kind == CMD_OP_PREACCEPT
+        is_ac = kind == CMD_OP_ACCEPT
+        is_cm = kind == CMD_OP_COMMIT
+
+        def pick(a, b, c_, d):
+            return jnp.where(is_pa, a,
+                             jnp.where(is_ac, b, jnp.where(is_cm, c_, d)))
+
+        new_st = jnp.where(valid, pick(pa_st, ac_st, cm_st, ap_st), st)
+        new_fl = jnp.where(valid, pick(pa_fl, fl, cm_fl, ap_fl), fl)
+        new_pr = jnp.where(valid, pick(pa_pr, ac_pr, pr, pr), pr)
+        new_ab = jnp.where(valid, pick(ab, ac_ab, ab, ab), ab)
+        new_ea = jnp.where(valid, pick(pa_ea, ac_ea, cm_ea, ap_ea), ea)
+        new_du = jnp.where(valid, pick(du, du, du, ap_du), du)
+        code = pick(pa_code, ac_code, cm_code, ap_code)
+        ts_out = pick(pa_out_ts, ac_ea, cm_ea, ap_ea)
+        do_reg = valid & pick(pa_wit, ac_ok, cm_ok, ap_ok)
+        regval = pick(witness, oex, cm_regval, cm_regval)
+
+        r_st = r_st.at[i].set(new_st)
+        r_fl = r_fl.at[i].set(new_fl)
+        r_pr = r_pr.at[i].set(new_pr)
+        r_ab = r_ab.at[i].set(new_ab)
+        r_ea = r_ea.at[i].set(new_ea)
+        r_du = r_du.at[i].set(new_du)
+
+        better = ~kv | _lex_before(km, regval[None, :])
+        take = do_reg & better & (kids >= 0)
+        nkm = jnp.where(take[:, None], regval[None, :], km)
+        k_km = k_km.at[i].set(nkm)
+        k_kv = k_kv.at[i].set(kv_raw | do_reg)
+
+        clock = jnp.where(valid & is_pa & pa_wit, wit_clock, clock)
+        out_code = out_code.at[i].set(jnp.where(valid, code, -1))
+        out_ts = out_ts.at[i].set(ts_out)
+        out_status = out_status.at[i].set(new_st)
+        return (r_st, r_fl, r_pr, r_ab, r_ea, r_du, k_km, k_kv,
+                clock, out_code, out_ts, out_status)
+
+    init = (st_0, fl_0, pr_0, ab_0, ea_0, du_0, km_0, kv_0,
+            jnp.asarray(clock, jnp.int32),
+            jnp.full(n, -1, jnp.int32), jnp.full((n, 3), neg, jnp.int32),
+            jnp.full(n, -1, jnp.int32))
+    (r_st, r_fl, r_pr, r_ab, r_ea, r_du, k_km, k_kv,
+     clock, out_code, out_ts, out_status) = \
+        jax.lax.fori_loop(0, n, body, init)
+
+    # single writeback: each row's / kid's last in-batch writer carries the
+    # chain's final value (padding and non-last writes drop)
+    wrow = jnp.where(op_rlast, op_row, cap)
+    status = status.at[wrow].set(r_st, mode="drop")
+    flags = flags.at[wrow].set(r_fl, mode="drop")
+    promised = promised.at[wrow].set(r_pr, mode="drop")
+    accepted = accepted.at[wrow].set(r_ab, mode="drop")
+    execute_at = execute_at.at[wrow].set(r_ea, mode="drop")
+    durability = durability.at[wrow].set(r_du, mode="drop")
+    wkid = jnp.where(op_klast, op_keys, kcap).reshape(-1)
+    kmax = kmax.at[wkid].set(k_km.reshape(-1, 3), mode="drop")
+    kmax_valid = kmax_valid.at[wkid].set(k_kv.reshape(-1), mode="drop")
+    return (status, flags, promised, accepted, execute_at, durability,
+            kmax, kmax_valid, clock, out_code, out_ts, out_status,
+            cmd_checksum(out_code, out_status, out_ts, clock))
+
+
 def jit_cache_sizes() -> dict:
     """Compiled-variant counts of the warmable hot-path kernels: the bench
     snapshots this around its timed windows to assert warmup() covered every
@@ -815,4 +1192,5 @@ def jit_cache_sizes() -> dict:
         "range_finalize_csr": range_finalize_csr._cache_size(),
         "kid_word_scatter": kid_word_scatter._cache_size(),
         "fused_execution_frontier": fused_execution_frontier._cache_size(),
+        "cmd_tick": cmd_tick._cache_size(),
     }

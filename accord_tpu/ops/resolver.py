@@ -129,7 +129,9 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
            nnz_tiers=None, scatter_nnz_tiers=None,
            range_cap: int = 64, store_tiers=(1, 2),
            exec_caps=(), out_tiers=(), range_out_tiers=None,
-           kid_cap: int = 4096) -> None:
+           kid_cap: int = 4096, cmd_caps=(), cmd_key_caps=(1024,),
+           cmd_kpad: int = 4, cmd_op_tiers=None,
+           cmd_promote_modes=(False,)) -> None:
     """Pre-compile the jit shape tiers the async pipeline uses (first
     compilation costs seconds on a tunnelled TPU; production would do the
     same at process start). The jit cache is process-global, so one call
@@ -153,7 +155,12 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
     kid-table shape, range_finalize_csr across (nnz, batch, out_cap), and
     the kid-table word scatter per scatter-nnz tier. `range_out_tiers`
     overrides the range kernel's out ladder (pass () for key-only
-    workloads, where compiling the range compaction would be waste)."""
+    workloads, where compiling the range compaction would be waste).
+    `cmd_caps` (opt-in) additionally warms the device coordination plane:
+    cmd_tick and its lane scatters across every (arena cap, key cap,
+    op tier, promote mode) in use -- the same coverage
+    ops.cmd_plane.warmup_cmd_plane provides standalone, folded in here so
+    one warmup call covers deps + exec + cmd kernels."""
     import jax.numpy as jnp
     from accord_tpu.ops.kernels import (NNZ_TIERS, SCATTER_NNZ_TIERS,
                                         arena_scatter, arena_scatter_keys,
@@ -263,6 +270,15 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
                     out = range_finalize_csr(of, zz, zz, ok, sb, sknd,
                                              rs, re_, rts, rkd, rvl,
                                              table, out_cap=oc)
+    if cmd_caps:
+        from accord_tpu.ops.cmd_plane import (CMD_OP_TIERS,
+                                              warmup_cmd_plane)
+        warmup_cmd_plane(
+            caps=tuple(cmd_caps), key_caps=tuple(cmd_key_caps),
+            kpad=cmd_kpad,
+            op_tiers=(CMD_OP_TIERS if cmd_op_tiers is None
+                      else tuple(cmd_op_tiers)),
+            promote_modes=tuple(cmd_promote_modes))
     if out is not None:
         import jax
         jax.block_until_ready(out)
@@ -2014,18 +2030,51 @@ class BatchDepsResolver(DepsResolver):
         dq = self._deps_queues.pop(id(node), [])
         items: List[_Item] = []
         t0 = _time.perf_counter()
-        for (store, t, p, route, ballot, out) in pa:
+
+        def _finish(store, t, p, out, outcome):
+            if outcome in (AcceptOutcome.REJECTED_BALLOT,
+                           AcceptOutcome.TRUNCATED):
+                out.try_set_success((outcome, None, None))
+                return
+            items.append(_Item(store, t, store.owned(p.keys),
+                               store.command(t).execute_at, out, outcome))
+
+        def _host_one(store, t, p, route, ballot, out):
             try:
                 outcome = commands.preaccept(store, t, p, route, ballot)
             except BaseException as e:  # noqa: BLE001
                 out.try_set_failure(e)
+                return
+            _finish(store, t, p, out, outcome)
+
+        # contiguous same-store spans route through the device command
+        # arena as ONE cmd_tick dispatch (synchronous within the drain, so
+        # timing -- and thus histories -- stay bit-identical to the host
+        # loop); stores without a plane keep the inline path
+        i = 0
+        while i < len(pa):
+            store = pa[i][0]
+            plane = getattr(store, "cmd_plane", None)
+            if plane is None:
+                _host_one(*pa[i])
+                i += 1
                 continue
-            if outcome in (AcceptOutcome.REJECTED_BALLOT,
-                           AcceptOutcome.TRUNCATED):
-                out.try_set_success((outcome, None, None))
-                continue
-            items.append(_Item(store, t, store.owned(p.keys),
-                               store.command(t).execute_at, out, outcome))
+            j = i
+            while j < len(pa) and pa[j][0] is store:
+                j += 1
+            batch = pa[i:j]
+            try:
+                from accord_tpu.ops.cmd_plane import CmdOp
+                res = plane.eval_batch([
+                    CmdOp.preaccept(t, p, route, ballot)
+                    for (_s, t, p, route, ballot, _o) in batch])
+            except BaseException:  # noqa: BLE001
+                for entry in batch:
+                    _host_one(*entry)
+            else:
+                for (st_, t, p, _route, _ballot, out), r in zip(batch, res):
+                    _finish(st_, t, p, out, r.outcome)
+            i = j
         dt = _time.perf_counter() - t0
         self.preaccept_s += dt
         if REC.enabled:
@@ -2415,16 +2464,19 @@ class BatchDepsResolver(DepsResolver):
             ent_ok = np.zeros(nv, dtype=bool)
             for e, _, _ in g.rents:
                 ent_ok[e] = True
-            # the bound here is host-O(1) (entries x live rows, no per-key
-            # pass), so it always feeds the policy exactly; the policy
-            # still pins the tier so quiet dispatches cannot flap the jit
-            # cache between ladder rungs
-            nvalid = int(np.count_nonzero(ranges.valid[:ranges.count]))
-            bound = max(len(g.rents) * nvalid, 1)
-            if self.device_out_bound:
-                out_cap = self._outcap(g.arena, "range").pick(bound)
+            pol = self._outcap(g.arena, "range")
+            if not self.device_out_bound or pol.cold:
+                # cold (or device bounds off): seed with the host product
+                # bound (entries x live rows) the stab count can never
+                # exceed; after the first dispatch the DEVICE stab count
+                # riding back with each result feeds the policy instead,
+                # so steady state pays no host count_nonzero pass
+                nvalid = int(np.count_nonzero(ranges.valid[:ranges.count]))
+                bound = max(len(g.rents) * nvalid, 1)
+                out_cap = (pol.pick(bound) if self.device_out_bound
+                           else out_tier(bound))
             else:
-                out_cap = out_tier(bound)
+                out_cap = pol.pick(pol.estimate(len(g.rents)))
             rsnap = ranges.device_arrays()
             j_ok = jnp.asarray(ent_ok)
             plan.rfin_calls.append((g, lambda rsnap=rsnap, j_ok=j_ok,
@@ -2847,11 +2899,17 @@ class BatchDepsResolver(DepsResolver):
         buf = self._fetch_np(g, "rfin_np", g.rfin_dev)
         if not self._csum_ok(call, g, buf):
             return None     # corrupted readback: caught before decode
-        indptr, dep_rows, _, _ = buf
+        import time as _time
+        indptr, dep_rows, _, dbound, _ = buf
+        t0 = _time.perf_counter()
+        pol = self._outcap(g.arena, "range")
+        pol.observe(int(dbound), max(len(g.rents), 1))
+        self.bound_readback_s += _time.perf_counter() - t0
         if int(indptr[-1]) > dep_rows.shape[0]:
-            # defensively bump the pinned tier (the host bound is exact, so
-            # only a mid-flight rseq change can land here)
-            self._outcap(g.arena, "range").overflowed()
+            # defensively bump the pinned tier (the stab-count bound is a
+            # true superset of the compaction, so only a mid-flight rseq
+            # change or an undersized warm estimate can land here)
+            pol.overflowed()
             return None
         ids = g.arena.ranges.ids_np
         raw: List[tuple] = []

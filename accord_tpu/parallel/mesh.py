@@ -451,6 +451,12 @@ def sharded_finalize_csr(mesh: Mesh):
          lane block; the fragments sum-merge (disjoint positions, zeros
          elsewhere) outside the shard_map body, in the same jit.
 
+    The device out-cap BOUND (the kid-table row-mask popcount riding back
+    with each result) is additionally sharded over the 'model' axis: each
+    model replica popcounts a contiguous slot block of the kid table and a
+    psum over 'model' restores the replicated scalar, so the model lanes
+    stop duplicating the full [slots x words] SWAR pass.
+
     Word order equals row order and shards partition words contiguously,
     so (indptr, dep_rows, dep_ts, bound, csum) is bit-identical to the
     single-device finalize_csr -- the csr_checksum integrity word is
@@ -462,6 +468,7 @@ def sharded_finalize_csr(mesh: Mesh):
     resolver on the mesh shares one compiled kernel per (shape, out_cap)."""
     from accord_tpu.ops.kernels import _popcount_u32
     data = mesh.shape["data"]
+    model = mesh.shape["model"]
 
     def run(packed, word_off, kid_rows, slot_subj, slot_kid,
             subj_row, act_ts, out_cap: int):
@@ -476,9 +483,27 @@ def sharded_finalize_csr(mesh: Mesh):
             s = ssub.shape[0]
             ok = (ssub >= 0) & (ssub < b) & (skid >= 0) & (skid < kc)
             kid_m = kid_l[jnp.clip(skid, 0, kc - 1)]
-            bound_l = jnp.sum(jnp.where(
-                ok, jnp.sum(_popcount_u32(kid_m), axis=1, dtype=jnp.int32),
-                0), dtype=jnp.int32)
+            if s % model == 0:
+                # kid-table popcount sharded over 'model': each model
+                # replica bounds a contiguous slot block (the nnz tiers
+                # are 32-multiples, so the split is exact), psum restores
+                # the model-replicated scalar the out_specs promise --
+                # integer partial sums, so bit-identical to the full
+                # reduction the single-device kernel computes
+                mi = jax.lax.axis_index("model")
+                sl = s // model
+                skid_b = jax.lax.dynamic_slice_in_dim(skid, mi * sl, sl)
+                ok_b = jax.lax.dynamic_slice_in_dim(ok, mi * sl, sl)
+                kid_b = kid_l[jnp.clip(skid_b, 0, kc - 1)]
+                bound_l = jax.lax.psum(jnp.sum(jnp.where(
+                    ok_b,
+                    jnp.sum(_popcount_u32(kid_b), axis=1, dtype=jnp.int32),
+                    0), dtype=jnp.int32), "model")
+            else:
+                bound_l = jnp.sum(jnp.where(
+                    ok,
+                    jnp.sum(_popcount_u32(kid_m), axis=1, dtype=jnp.int32),
+                    0), dtype=jnp.int32)
             so = jnp.clip(ssub, 0, b - 1)
             m = jnp.where(ok[:, None], blk_l[so] & kid_m, jnp.uint32(0))
             r = srow[so]
@@ -551,7 +576,12 @@ def warmup_sharded(mesh: Mesh, num_buckets: int = 256, cap: int = 4096,
                    range_cap: Optional[int] = None,
                    store_tiers: Tuple[int, ...] = (1, 2),
                    out_tiers: Tuple[int, ...] = (),
-                   kid_cap: int = 4096) -> None:
+                   kid_cap: int = 4096,
+                   cmd_caps: Tuple[int, ...] = (),
+                   cmd_key_caps: Tuple[int, ...] = (1024,),
+                   cmd_kpad: int = 4,
+                   cmd_op_tiers: Optional[Tuple[int, ...]] = None,
+                   cmd_promote_modes: Tuple[bool, ...] = (False,)) -> None:
     """Pre-compile the sharded hot kernels' (batch tier, nnz tier, store
     tier) jit cross product (the sharded twin of ops.resolver.warmup; same
     padding ladders the overlapped pipeline dispatches). Store tiers >= 2
@@ -562,7 +592,10 @@ def warmup_sharded(mesh: Mesh, num_buckets: int = 256, cap: int = 4096,
     finalize shape a steady-state burn dispatches. One call covers every
     ShardedBatchDepsResolver on the same mesh + (num_buckets, cap,
     range_cap) -- the kernel builders are lru_cached by (mesh, width) and
-    jit caches by shape."""
+    jit caches by shape. `cmd_caps` (opt-in) folds in the device
+    coordination plane's warmup (cmd_tick + its lane scatters) -- the cmd
+    arena is store-local and replicated, so the single-device variants are
+    the ones a sharded deployment dispatches too."""
     from accord_tpu.ops.encoding import WITNESS_TABLE
     from accord_tpu.ops.kernels import NNZ_TIERS
     if nnz_tiers is None:
@@ -624,6 +657,14 @@ def warmup_sharded(mesh: Mesh, num_buckets: int = 256, cap: int = 4096,
                 for oc in out_tiers:
                     out = fin(packed, zero_off, kid_rows, subj, kidx,
                               srow, ts, out_cap=oc)
+    if cmd_caps:
+        from accord_tpu.ops.cmd_plane import (CMD_OP_TIERS,
+                                              warmup_cmd_plane)
+        warmup_cmd_plane(
+            caps=cmd_caps, key_caps=cmd_key_caps, kpad=cmd_kpad,
+            op_tiers=(CMD_OP_TIERS if cmd_op_tiers is None
+                      else cmd_op_tiers),
+            promote_modes=cmd_promote_modes)
     if out is not None:
         jax.block_until_ready(out)
 

@@ -332,6 +332,14 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
     cluster.check_no_failures()
     verifier.check_final_state(cluster.converged_key_lists())
     report.counters = cluster.total_counters()
+    # fold command-plane counters (dispatches, upload bytes, fastpath evals,
+    # fallbacks) in beside the engine counters so burn JSON carries them
+    for node in cluster.nodes.values():
+        for store in node.command_stores.all():
+            if store.cmd_plane is not None:
+                for k, v in store.cmd_plane.snapshot().items():
+                    if isinstance(v, (int, float)):
+                        report.counters[k] = report.counters.get(k, 0) + v
     from accord_tpu.obs.metrics import MetricsRegistry
     report.registry = MetricsRegistry()
     for node in cluster.nodes.values():

@@ -37,6 +37,8 @@ class ClusterConfig:
                  preaccept_timeout_ms: float = 1000.0,
                  exec_plane: bool = False, exec_tick_ms: float = 2.0,
                  exec_fuse: bool = True,
+                 cmd_plane: bool = False, cmd_plane_cap: int = 1024,
+                 cmd_plane_key_cap: int = 1024,
                  store_delays: bool = False, store_delay_max_us: int = 2000,
                  clock_drift: bool = False, clock_offset_max_us: int = 100_000,
                  clock_drift_max_ppm: int = 10_000):
@@ -79,6 +81,13 @@ class ClusterConfig:
         # fuse the exec planes' per-store frontier calls into one per-node
         # dispatch (ExecCoordinator); solo planes keep the plain kernel
         self.exec_fuse = exec_fuse
+        # device command arena (ops/cmd_plane.py): batch-evaluate PreAccept
+        # witnesses, Accept ballot checks and Commit/Apply promotions in one
+        # cmd_tick dispatch per drain, host handlers as residuals. False =
+        # the pure Python state machines (the differential baseline)
+        self.cmd_plane = cmd_plane
+        self.cmd_plane_cap = cmd_plane_cap
+        self.cmd_plane_key_cap = cmd_plane_key_cap
         # adversarial simulator knobs (reference: DelayedCommandStores async
         # loads + per-node clock drift, burn/BurnTest.java:330-340)
         self.store_delays = store_delays
@@ -319,6 +328,12 @@ class Cluster:
                     device_latency_ms=self.config.device_latency_ms)
                 if coordinator is not None:
                     coordinator.register(store.exec_plane)
+        if self.config.cmd_plane:
+            from accord_tpu.ops.cmd_plane import CmdPlane
+            for store in node.command_stores.all():
+                store.cmd_plane = CmdPlane(
+                    store, initial_cap=self.config.cmd_plane_cap,
+                    key_cap=self.config.cmd_plane_key_cap)
         if self.config.store_delays:
             # async store-op delays (reference: DelayedCommandStores): each
             # store defers every op by a deterministic random delay,

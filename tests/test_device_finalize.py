@@ -397,6 +397,13 @@ def test_device_bound_and_range_stab_randomized_differential():
     # same finalized path (only the out_cap sizing differs)
     assert dev.range_subject_device_decodes > 0
     assert dev.legacy_decodes == 0 and dev.finalize_fallbacks == 0
+    # the range lane's out_cap is now fed by the DEVICE stab-count bound
+    # riding back with each range_finalize_csr result: after the first
+    # dispatch the policy is warm, so steady-state range sizing pays no
+    # host entries*nvalid pass (and the differential above proves the
+    # device-bound-sized caps never undersize the compaction)
+    rpol = dev._outcap(dev._arenas[id(store)], "range")
+    assert not rpol.cold, "range lane never observed a device stab bound"
     assert hostb.range_subject_device_decodes > 0
     assert hostb.legacy_decodes == 0 and hostb.finalize_fallbacks == 0
     assert leg.legacy_decodes > 0 and leg.finalized_decodes == 0

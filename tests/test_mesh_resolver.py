@@ -155,6 +155,49 @@ def test_sharded_finalize_kernel_matches_single_device():
     assert overflowed and fit, "differential vacuous"
 
 
+def test_model_sharded_kid_bound_matches_single_device():
+    """The kid-table out-cap bound is popcounted over 'model'-axis slot
+    blocks (each model replica sums a contiguous slice, psum merges):
+    across nnz tiers and slot paddings the merged bound must stay
+    BIT-identical to the single-device kernel's full reduction -- integer
+    partial sums, so this is equality, not tolerance."""
+    import jax.numpy as jnp
+    from accord_tpu.ops.kernels import finalize_csr
+    from accord_tpu.parallel.mesh import sharded_finalize_csr
+
+    mesh = make_mesh()
+    assert mesh.shape["model"] > 1, \
+        "conftest mesh must exercise a real model axis"
+    data = mesh.shape["data"]
+    cap = 32 * data * 4
+    w = cap // 32
+    kern = sharded_finalize_csr(mesh)
+    rng = np.random.default_rng(31)
+    for s in (32, 64, 256):        # every nnz tier divides by the model axis
+        b, kc = 16, 128
+        packed = (rng.random((b, w, 32)) < 0.05)
+        packed = np.packbits(packed, axis=-1, bitorder="little") \
+            .view(np.uint32).reshape(b, w)
+        kid = (rng.random((kc, w, 32)) < 0.2)
+        kid = np.packbits(kid, axis=-1, bitorder="little") \
+            .view(np.uint32).reshape(kc, w)
+        args = (jnp.asarray(packed), jnp.asarray(0, jnp.int32),
+                jnp.asarray(kid),
+                jnp.asarray(rng.integers(-1, b + 2, s), jnp.int32),
+                jnp.asarray(rng.integers(0, kc + 1, s), jnp.int32),
+                jnp.asarray(rng.integers(-1, cap, b), jnp.int32),
+                jnp.asarray(rng.integers(0, 1 << 20, (cap, 3)), jnp.int32))
+        single = finalize_csr(*args, out_cap=2048)
+        sharded = kern(*args, out_cap=2048)
+        assert int(np.asarray(single[3])) == int(np.asarray(sharded[3])), \
+            f"nnz {s}: model-sharded bound != single-device bound"
+        assert int(np.asarray(single[3])) > 0, f"nnz {s}: bound vacuous"
+        for name, a, c in zip(("indptr", "dep_rows", "dep_ts"),
+                              single, sharded):
+            assert np.array_equal(np.asarray(a), np.asarray(c)), \
+                f"nnz {s}: sharded {name} != single-device"
+
+
 def test_sharded_finalize_e2e_and_zero_recompiles():
     """The sharded resolver rides the finalized-CSR harvest end to end
     (answers == single-device == host, zero legacy decodes), and after
