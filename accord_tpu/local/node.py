@@ -270,9 +270,17 @@ class Node:
         """Graceful stop of the device deps pipeline: flush every attached
         resolver's staged (encode-ahead) plans AND in-flight device calls
         for this node, so no enqueued AsyncResult strands once the scheduler
-        stops delivering this node's events. Idempotent; a node with no
-        batched resolver is a no-op. Ends by emitting a final metrics
-        snapshot through metrics_sink (when one is installed)."""
+        stops delivering this node's events. Idempotent -- a second call
+        (serve-mode Ctrl-C racing a client-driven shutdown) returns without
+        re-draining the already-flushed pipeline -- and safe when no
+        scheduler owns outstanding timers (an external event loop drives the
+        drain to completion synchronously; the resolver skips arming harvest
+        timers it would never see fire). A node with no batched resolver is
+        a no-op. Ends by emitting a final metrics snapshot through
+        metrics_sink (when one is installed)."""
+        if getattr(self, "_shutdown_done", False):
+            return
+        self._shutdown_done = True
         if self.command_stores is not None:
             drained = set()
             for store in self.command_stores.all():

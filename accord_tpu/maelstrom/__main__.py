@@ -14,6 +14,8 @@ import select
 import sys
 
 from accord_tpu.maelstrom.core import MaelstromNode
+from accord_tpu.serve.transport import (LineDecoder, decode_json_line,
+                                        encode_json_line)
 
 
 def serve(stdin=None, stdout=None, stderr=None) -> int:
@@ -23,7 +25,7 @@ def serve(stdin=None, stdout=None, stderr=None) -> int:
 
     def emit(dest: str, body: dict) -> None:
         packet = {"src": node.maelstrom_id, "dest": dest, "body": body}
-        stdout.write(json.dumps(packet) + "\n")
+        stdout.write(encode_json_line(packet).decode())
         stdout.flush()
 
     def log(msg: str) -> None:
@@ -31,22 +33,17 @@ def serve(stdin=None, stdout=None, stderr=None) -> int:
         stderr.flush()
 
     node = MaelstromNode(emit, log)
-    # raw fd reads with our own line buffer: select() + buffered readline()
-    # deadlocks (lines sit in the TextIO buffer while select blocks on the fd)
+    # raw fd reads with the shared push-parser (serve/transport.LineDecoder):
+    # select() + buffered readline() deadlocks (lines sit in the TextIO
+    # buffer while select blocks on the fd)
     fd = stdin.fileno()
-    buf = b""
+    decoder = LineDecoder()
     eof = False
 
     def pump(chunk: bytes) -> None:
-        nonlocal buf
-        buf += chunk
-        while b"\n" in buf:
-            line, buf = buf.split(b"\n", 1)
-            line = line.strip()
-            if not line:
-                continue
+        for line in decoder.feed(chunk):
             try:
-                node.handle(json.loads(line))
+                node.handle(decode_json_line(line))
             except json.JSONDecodeError as e:
                 log(f"bad json: {e}")
 

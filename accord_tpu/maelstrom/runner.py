@@ -7,7 +7,6 @@ accord transport, txn translation); only `emit` and the scheduler are
 swapped for a deterministic router over the sim PendingQueue."""
 from __future__ import annotations
 
-import json
 from typing import Dict, List, Optional
 
 from accord_tpu import api
@@ -15,6 +14,7 @@ from accord_tpu.local.node import TimeService
 from accord_tpu.maelstrom.core import KEY_DOMAIN, MaelstromNode
 from accord_tpu.obs.metrics import MetricsRegistry
 from accord_tpu.obs.trace import REC
+from accord_tpu.serve.transport import json_clone
 from accord_tpu.sim.queue import PendingQueue
 from accord_tpu.utils.rng import RandomSource
 
@@ -90,9 +90,9 @@ class Runner:
 
     def _emitter(self, src: str):
         def emit(dest: str, body: dict) -> None:
-            # JSON round trip: catch anything not actually serializable
-            packet = json.loads(json.dumps(
-                {"src": src, "dest": dest, "body": body}))
+            # JSON round trip (shared stdio codec): catch anything not
+            # actually serializable exactly as the real boundary would
+            packet = json_clone({"src": src, "dest": dest, "body": body})
             if dest.startswith("n"):
                 delay = self.rng.next_int_between(*self.latency_us)
                 self.queue.add(delay, lambda: self.nodes[dest].handle(packet))

@@ -133,6 +133,7 @@ class Histogram:
             "p50": round(self.percentile(50), 3),
             "p95": round(self.percentile(95), 3),
             "p99": round(self.percentile(99), 3),
+            "p999": round(self.percentile(99.9), 3),
             "max": round(self.max, 3) if self.max is not None else 0.0,
         }
 
@@ -186,7 +187,7 @@ class MetricsRegistry:
 
     def snapshot(self) -> dict:
         """Flat name -> value dict (histograms as {count, mean, p50, p95,
-        p99, max} sub-dicts) -- the single source for bench JSON."""
+        p99, p999, max} sub-dicts) -- the single source for bench JSON."""
         out = {}
         for name in sorted(self._metrics):
             m = self._metrics[name]
@@ -199,6 +200,16 @@ class MetricsRegistry:
             else:
                 out[name] = m.snapshot()
         return out
+
+    def snapshot_json(self, extra: Optional[dict] = None) -> str:
+        """snapshot() as one sorted JSON line -- the shared export behind
+        the serve node's periodic stderr metrics dump and bench_serve's
+        per-leg reports (machine-parseable, diff-stable key order)."""
+        import json
+        snap = self.snapshot()
+        if extra:
+            snap.update(extra)
+        return json.dumps(snap, sort_keys=True)
 
 
 class RegCounter:
@@ -372,4 +383,18 @@ GLOSSARY: Dict[str, str] = {
     "maelstrom.txn_ok": "maelstrom txns acknowledged ok",
     "maelstrom.errors": "maelstrom txns answered with an error",
     "maelstrom.reads_checked": "read results checked for prefix consistency",
+    # -- serving surface (NodeServer.metrics, serve/server.py) ---------------
+    "serve.admission_busy": "client txns answered BUSY by the admission governor",
+    "serve.admission_shed": "overload episodes shed into the resolver's adaptive window",
+    "serve.queue_depth": "high-water coordinations in flight behind admission",
+    "serve.transport_bytes_in": "socket-transport bytes received (frames + headers)",
+    "serve.transport_bytes_out": "socket-transport bytes sent (frames + headers)",
+    "serve.txn_ok": "client txns committed and acknowledged over the socket surface",
+    "serve.txn_error": "client txns answered with a protocol error",
+    # -- open-loop load harness (serve/loadgen.py, per-leg registry) ---------
+    "loadgen.ok": "txns acknowledged ok within the client timeout",
+    "loadgen.busy": "txns shed with an explicit BUSY reply",
+    "loadgen.errors": "txns answered with an error reply",
+    "loadgen.lost": "txns with unknown outcome (timeout or dead connection)",
+    "loadgen.latency_us": "client-observed commit latency per acknowledged txn",
 }

@@ -1972,6 +1972,25 @@ class BatchDepsResolver(DepsResolver):
             self._win_scale[id(node)] = min(4.0, s * 2.0)
             self.window_widens += 1
 
+    def note_admission_pressure(self, node, overloaded: bool) -> None:
+        """Admission-governor hook (serve/admission.py): entering overload
+        widens the node's staged window one notch -- the txns that ARE
+        admitted ride bigger, better-amortized dispatches while clients
+        shed as BUSY -- and leaving it snaps the scale back so the queue
+        latency the wide window buys doesn't outlive the episode. A no-op
+        unless adaptive_window is on (the serve server enables it)."""
+        if not self.adaptive_window:
+            return
+        if overloaded:
+            s = self._win_scale.get(id(node), 1.0)
+            if s < 4.0:
+                self._win_scale[id(node)] = min(4.0, s * 2.0)
+                self.window_widens += 1
+        else:
+            if self._win_scale.get(id(node), 1.0) > 1.0:
+                self._win_scale[id(node)] = 1.0
+                self.window_shrinks += 1
+
     def _tick(self, node) -> None:
         """One node tick. Serial mode (overlap_host=False) runs preaccept ->
         encode -> launch in this one event, exactly the pre-pipeline
@@ -3446,7 +3465,11 @@ class BatchDepsResolver(DepsResolver):
                              args={"did": did})
         self._inflight.setdefault(id(node), deque()).append(call)
         delay = getattr(node, "device_latency_ms", 4.0)
-        node.scheduler.once(delay, lambda: self._harvest(node))
+        # shutdown from an external event loop may arrive with no live
+        # scheduler; drain() blocking-harvests, so the timer is optional
+        scheduler = getattr(node, "scheduler", None)
+        if scheduler is not None:
+            scheduler.once(delay, lambda: self._harvest(node))
         self._ensure_poll(node)
 
     def _dispatch(self, node, items: List[_Item]) -> None:

@@ -1,0 +1,15 @@
+"""The serving surface: a real N-process cluster on one host.
+
+The sim (`accord_tpu/sim/`) proves the protocol under a deterministic
+scheduler; maelstrom (`accord_tpu/maelstrom/`) speaks JSON-over-stdio to
+Jepsen. This package is the third surface -- the one "heavy traffic" claims
+are made against: each node is a real OS process wrapping `local/node.py`
+in an asyncio event loop, nodes and clients speak one length-prefixed
+socket codec built on `sim/wire.py` (`serve/transport.py`), an open-loop
+Poisson load harness sweeps offered load (`serve/loadgen.py`), and a
+token-bucket + queue-depth governor sheds overload as explicit BUSY
+replies instead of collapsing (`serve/admission.py`). Every client history
+rides the list-append format and is checked post-run by the sim's
+strict-serializability verifier, so throughput numbers come with a
+linearizability check attached.
+"""

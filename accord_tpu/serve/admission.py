@@ -1,0 +1,125 @@
+"""Admission control: a token bucket + queue-depth governor in front of
+`Node.coordinate`.
+
+An open-loop client population does not slow down when the cluster does,
+so an unprotected node converts overload into an unbounded coordination
+queue -- every admitted txn's latency grows without limit and nothing ever
+completes inside its client timeout (congestion collapse). The governor
+keeps the serving node in its operating region instead:
+
+- a **token bucket** caps the sustained admitted rate (`rate_per_s`, with
+  `burst` tokens of headroom for arrival jitter);
+- a **queue-depth bound** (`max_inflight`) caps coordinations in flight
+  regardless of rate, so a slow patch (device warmup, a crashed peer's
+  timeouts) cannot pile up work the node has already accepted;
+- everything not admitted is answered with an explicit BUSY **reply** --
+  the client always hears back, and an open-loop harness can count sheds
+  instead of mistaking them for losses.
+
+Sustained shedding additionally *sheds into the device pipeline*: the
+`on_pressure` hook (wired by serve/server.py to
+`BatchDepsResolver.note_admission_pressure`) widens the staged dispatch
+window while overloaded, so the work that IS admitted rides bigger, better
+amortized device batches. Recovery lets the resolver's empty-drain
+adaptation shrink the window back.
+
+Counters land in the registry the server exposes over its stats endpoint:
+`serve.admission_busy` (BUSY replies) and `serve.admission_shed`
+(overload-pressure engagements of the window governor).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from accord_tpu.obs.metrics import MetricsRegistry
+
+
+class TokenBucket:
+    """Classic token bucket over a caller-supplied clock: `rate_per_s`
+    sustained, `burst` capacity. Time is injected (seconds, monotone) so
+    the unit tests and the sim can drive it deterministically."""
+
+    __slots__ = ("rate_per_s", "burst", "_tokens", "_last_s")
+
+    def __init__(self, rate_per_s: float, burst: float):
+        assert rate_per_s > 0 and burst >= 1
+        self.rate_per_s = float(rate_per_s)
+        self.burst = float(burst)
+        self._tokens = float(burst)
+        self._last_s: Optional[float] = None
+
+    def try_take(self, now_s: float) -> bool:
+        if self._last_s is not None:
+            elapsed = max(0.0, now_s - self._last_s)
+            self._tokens = min(self.burst,
+                               self._tokens + elapsed * self.rate_per_s)
+        self._last_s = now_s
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            return True
+        return False
+
+
+class AdmissionController:
+    """try_admit()/on_complete() around every client txn; BUSY when the
+    bucket is dry or the coordination queue is at its depth bound."""
+
+    # pressure hysteresis: shedding engages the governor immediately;
+    # it disengages only after a full quiet window with zero sheds
+    QUIET_WINDOW_S = 1.0
+
+    def __init__(self, rate_per_s: float, burst: int, max_inflight: int,
+                 registry: Optional[MetricsRegistry] = None,
+                 on_pressure: Optional[Callable[[bool], None]] = None):
+        self.bucket = TokenBucket(rate_per_s, burst)
+        self.max_inflight = int(max_inflight)
+        self.metrics = registry if registry is not None else MetricsRegistry()
+        self.on_pressure = on_pressure
+        self.inflight = 0
+        self.closed = False  # graceful shutdown: everything answers BUSY
+        self._overloaded = False
+        self._last_shed_s: Optional[float] = None
+        self._busy = self.metrics.counter("serve.admission_busy")
+        self._shed = self.metrics.counter("serve.admission_shed")
+        self._depth = self.metrics.gauge("serve.queue_depth")
+
+    def try_admit(self, now_s: float) -> bool:
+        """One client txn arrived: admit (and count it in flight) or shed.
+        Callers MUST pair every True with a later on_complete()."""
+        if (not self.closed and self.inflight < self.max_inflight
+                and self.bucket.try_take(now_s)):
+            self.inflight += 1
+            if self.inflight > self._depth.value:
+                self._depth.set(self.inflight)
+            self._maybe_recover(now_s)
+            return True
+        self._busy.inc()
+        self._last_shed_s = now_s
+        if not self._overloaded:
+            # transition into overload: engage the window governor once
+            # per episode, not once per shed reply
+            self._overloaded = True
+            self._shed.inc()
+            if self.on_pressure is not None:
+                self.on_pressure(True)
+        return False
+
+    def on_complete(self, now_s: float) -> None:
+        self.inflight -= 1
+        assert self.inflight >= 0, "on_complete without a matching admit"
+        self._maybe_recover(now_s)
+
+    def _maybe_recover(self, now_s: float) -> None:
+        if (self._overloaded and self._last_shed_s is not None
+                and now_s - self._last_shed_s >= self.QUIET_WINDOW_S):
+            self._overloaded = False
+            if self.on_pressure is not None:
+                self.on_pressure(False)
+
+    @property
+    def busy_count(self) -> int:
+        return self._busy.value
+
+    @property
+    def shed_count(self) -> int:
+        return self._shed.value

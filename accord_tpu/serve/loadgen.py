@@ -1,0 +1,279 @@
+"""Open-loop load harness for the serve cluster.
+
+Closed-loop drivers (the sim burn, the in-process maelstrom workload) wait
+for completions before issuing more work, so they can never observe what
+overload does to latency -- the system sets its own arrival rate. This
+harness is **open-loop**: arrivals are a Poisson process at a configured
+offered rate, issued whether or not earlier txns completed (the
+coordinated-omission-free shape real user traffic has). A sweep runs legs
+of increasing offered load, the last one deliberately past the cluster's
+admission capacity, and reports per leg:
+
+- committed-txn/s and the p50/p99/p999 client-observed commit latency,
+  from an `obs.metrics` registry histogram per leg;
+- BUSY sheds (admission control working) vs errors vs lost replies --
+  every issued txn is accounted for in exactly one bucket.
+
+Every completed txn is recorded in the list-append history format and the
+whole run is checked by `sim/verifier.py`'s strict-serializability
+checker (`verify_history`), so a throughput table is only reported for a
+history that linearizes.
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import math
+import time
+from typing import Dict, List, Optional, Tuple
+
+from accord_tpu.obs.metrics import Histogram, MetricsRegistry
+from accord_tpu.serve import transport
+from accord_tpu.sim.verifier import StrictSerializabilityVerifier
+from accord_tpu.utils.rng import RandomSource
+
+
+class _NodeConn:
+    """One client connection to one node: request/reply matched by msg_id,
+    lost connections resolve every outstanding future with None."""
+
+    def __init__(self, addr: Tuple[str, int]):
+        self.addr = addr
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._task: Optional[asyncio.Task] = None
+        self.alive = False
+
+    async def connect(self) -> None:
+        self.reader, self.writer = await asyncio.open_connection(*self.addr)
+        self.alive = True
+        self._task = asyncio.get_running_loop().create_task(self._pump())
+
+    async def _pump(self) -> None:
+        decoder = transport.FrameDecoder()
+        try:
+            while True:
+                chunk = await self.reader.read(1 << 16)
+                if not chunk:
+                    break
+                for payload in decoder.feed(chunk):
+                    env = transport.decode_message(payload)
+                    fut = self._pending.pop(env.get("msg_id"), None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(env)
+        except Exception:
+            pass
+        finally:
+            self.alive = False
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_result(None)
+            self._pending.clear()
+
+    async def request(self, env: dict, timeout_s: float) -> Optional[dict]:
+        """Send one envelope, await its reply; None on timeout or a dead
+        connection (the caller decides what 'unknown outcome' means)."""
+        if not self.alive:
+            return None
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[env["msg_id"]] = fut
+        try:
+            self.writer.write(transport.encode_envelope(env))
+        except Exception:
+            self._pending.pop(env["msg_id"], None)
+            return None
+        try:
+            return await asyncio.wait_for(fut, timeout=timeout_s)
+        except asyncio.TimeoutError:
+            self._pending.pop(env["msg_id"], None)
+            return None
+
+    async def close(self) -> None:
+        if self.writer is not None:
+            try:
+                self.writer.close()
+                await self.writer.wait_closed()
+            except Exception:
+                pass
+        if self._task is not None:
+            self._task.cancel()
+
+
+class LoadClient:
+    """Connections to every node + the shared msg-id space."""
+
+    def __init__(self, addrs: Dict[int, Tuple[str, int]]):
+        self.conns = {nid: _NodeConn(addr) for nid, addr in addrs.items()}
+        self._msg_ids = itertools.count(1)
+
+    async def connect(self) -> None:
+        for conn in self.conns.values():
+            await conn.connect()
+
+    async def close(self) -> None:
+        for conn in self.conns.values():
+            await conn.close()
+
+    def next_msg_id(self) -> int:
+        return next(self._msg_ids)
+
+    async def admin(self, nid: int, kind: str,
+                    timeout_s: float = 30.0) -> Optional[dict]:
+        return await self.conns[nid].request(
+            {"t": kind, "msg_id": self.next_msg_id()}, timeout_s)
+
+
+class LoadGen:
+    """The open-loop generator + history recorder. One instance spans a
+    whole sweep so values stay globally unique and the recorded history is
+    one coherent list-append run."""
+
+    def __init__(self, client: LoadClient, seed: int = 1,
+                 key_count: int = 16, write_ratio: float = 0.5,
+                 max_keys_per_txn: int = 2, txn_timeout_s: float = 15.0):
+        self.client = client
+        self.rng = RandomSource(seed)
+        self.keys = list(range(key_count))
+        self.write_ratio = write_ratio
+        self.max_keys_per_txn = max_keys_per_txn
+        self.txn_timeout_s = txn_timeout_s
+        self._next_value = itertools.count(1)
+        self._t0 = time.monotonic()
+        # the recorded history: issue marks + one entry per issued txn
+        self.issues: List[Tuple[int, int]] = []   # (value, start_us)
+        self.entries: List[dict] = []
+
+    def _now_us(self) -> int:
+        return int((time.monotonic() - self._t0) * 1e6)
+
+    def _gen_ops(self):
+        """Reads first, then appends of ONE fresh value to the write keys:
+        the reply's read echoes are then exactly the txn's observed
+        pre-state (no intra-txn visibility), which is the verifier's
+        witness format; one value per txn mirrors the burn's ListUpdate."""
+        nkeys = 1 + self.rng.next_int(self.max_keys_per_txn)
+        chosen = sorted({self.rng.pick(self.keys) for _ in range(nkeys)})
+        ops = [["r", k, None] for k in chosen]
+        value = None
+        writes: Dict[int, int] = {}
+        if self.rng.decide(self.write_ratio):
+            value = next(self._next_value)
+            for k in chosen:
+                ops.append(["append", k, value])
+                writes[k] = value
+        return ops, value, writes, chosen
+
+    async def _issue_one(self, nid: int, registry: MetricsRegistry) -> None:
+        ops, value, writes, read_keys = self._gen_ops()
+        start_us = self._now_us()
+        if value is not None:
+            self.issues.append((value, start_us))
+        env = {"t": "txn", "msg_id": self.client.next_msg_id(), "ops": ops}
+        reply = await self.client.conns[nid].request(env, self.txn_timeout_s)
+        end_us = self._now_us()
+        entry = {"node": nid, "start_us": start_us, "end_us": end_us,
+                 "writes": writes, "reads": {}}
+        if reply is None:
+            entry["outcome"] = "lost"  # timeout/disconnect: outcome unknown
+            registry.counter("loadgen.lost").inc()
+        elif reply["t"] == "busy":
+            entry["outcome"] = "busy"
+            registry.counter("loadgen.busy").inc()
+        elif reply["t"] == "error":
+            entry["outcome"] = "error"
+            entry["error"] = reply.get("text", "")
+            registry.counter("loadgen.errors").inc()
+        else:
+            assert reply["t"] == "txn_ok", reply
+            entry["outcome"] = "ok"
+            for op, key, val in reply["txn"]:
+                if op == "r":
+                    entry["reads"][key] = tuple(val)
+            assert set(entry["reads"]) == set(read_keys)
+            registry.counter("loadgen.ok").inc()
+            registry.histogram("loadgen.latency_us").observe(
+                end_us - start_us)
+        self.entries.append(entry)
+
+    async def run_leg(self, rate_per_s: float, duration_s: float,
+                      nodes: Optional[List[int]] = None) -> dict:
+        """One open-loop leg: Poisson arrivals at `rate_per_s` for
+        `duration_s`, coordinators drawn uniformly from `nodes`. Waits for
+        every issued txn to resolve (or time out) before reporting."""
+        nodes = nodes if nodes is not None else sorted(self.client.conns)
+        registry = MetricsRegistry()
+        tasks: List[asyncio.Task] = []
+        loop = asyncio.get_running_loop()
+        t_end = time.monotonic() + duration_s
+        while time.monotonic() < t_end:
+            nid = nodes[self.rng.next_int(len(nodes))]
+            tasks.append(loop.create_task(self._issue_one(nid, registry)))
+            # exponential interarrival: open loop, no completion coupling
+            u = max(self.rng.next_float(), 1e-9)
+            await asyncio.sleep(-math.log(u) / rate_per_s)
+        if tasks:
+            await asyncio.wait(tasks, timeout=self.txn_timeout_s + 5.0)
+        snap = registry.snapshot()
+        hist = snap.get("loadgen.latency_us", {})
+        ok = snap.get("loadgen.ok", 0)
+        return {
+            "offered_per_s": rate_per_s,
+            "issued": len(tasks),
+            "ok": ok,
+            "busy": snap.get("loadgen.busy", 0),
+            "errors": snap.get("loadgen.errors", 0),
+            "lost": snap.get("loadgen.lost", 0),
+            "committed_per_s": round(ok / duration_s, 1),
+            "p50_us": hist.get("p50", 0.0),
+            "p99_us": hist.get("p99", 0.0),
+            "p999_us": hist.get("p999", 0.0),
+            "max_us": hist.get("max", 0.0),
+        }
+
+    async def sweep(self, legs: List[Tuple[str, float, float]],
+                    settle_s: float = 0.5) -> Dict[str, dict]:
+        """Run (name, rate, duration) legs back to back; a short settle
+        between legs lets in-flight tails drain out of the next leg's
+        histogram."""
+        out = {}
+        for name, rate, duration in legs:
+            out[name] = await self.run_leg(rate, duration)
+            await asyncio.sleep(settle_s)
+        return out
+
+
+def verify_history(issues: List[Tuple[int, int]], entries: List[dict],
+                   final_lists: Optional[Dict[int, tuple]] = None
+                   ) -> StrictSerializabilityVerifier:
+    """Replay a recorded history through the sim's strict-serializability
+    checker; raises sim.verifier.HistoryViolation on the first anomaly.
+    Only "ok" entries are witnessed; busy/error/lost txns leave
+    their values as maybe-writes (allowed, never required) -- except that
+    `final_lists` (the converged authoritative state) must still extend
+    every observed order and contain every *acked* write."""
+    verifier = StrictSerializabilityVerifier()
+    for value, start_us in issues:
+        verifier.on_issue_write(value, start_us)
+    for entry in sorted((e for e in entries if e["outcome"] == "ok"),
+                        key=lambda e: e["end_us"]):
+        verifier.witness(entry["start_us"], entry["end_us"],
+                         dict(entry["reads"]), dict(entry["writes"]))
+    if final_lists is not None:
+        verifier.check_final_state(
+            {k: tuple(v) for k, v in final_lists.items()})
+    return verifier
+
+
+def percentile_exact(samples: List[float], p: float) -> float:
+    """Exact sample percentile (nearest-rank); the bench cross-checks the
+    histogram estimates against this on the raw latencies it keeps."""
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    idx = max(0, math.ceil(len(s) * p / 100.0) - 1)
+    return s[idx]
+
+
+__all__ = ["LoadClient", "LoadGen", "verify_history", "percentile_exact",
+           "Histogram"]

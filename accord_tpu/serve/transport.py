@@ -1,0 +1,142 @@
+"""The serve codec: length-prefixed frames over byte streams, one payload
+codec shared with the simulator.
+
+Framing and payload encoding are deliberately separate layers:
+
+- **Frames** are `!I`-prefixed byte strings (4-byte big-endian length, then
+  exactly that many payload bytes). `FrameDecoder` is a push parser -- feed
+  it chunks as they arrive off a socket and it yields every completed
+  payload, holding partial headers/payloads across feeds -- so the server
+  and load generator never care how TCP segmented the stream.
+- **Payloads** round-trip through `sim/wire.py` (`encode_message` /
+  `decode_message`), the same value-copy codec every simulated message
+  already rides. Sim and serve therefore speak one serialization: an accord
+  Request pickled into a sim packet and one pickled into a socket frame are
+  byte-identical payloads.
+
+The maelstrom executable's newline-delimited JSON is the same push-parser
+shape one layer down, so its codec lives here too (`LineDecoder`,
+`encode_json_line`, `json_clone`) and `accord_tpu/maelstrom/` consumes
+these helpers instead of keeping its own framing loop.
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import Iterator, List
+
+from accord_tpu.sim import wire
+
+# one frame header: payload byte length, 4-byte big-endian unsigned
+_HEADER = struct.Struct("!I")
+HEADER_BYTES = _HEADER.size
+
+# hard per-frame ceiling: a corrupt/hostile header must not make the
+# decoder buffer gigabytes before noticing (64 MiB dwarfs any real
+# envelope; deps payloads are KBs)
+MAX_FRAME_BYTES = 64 << 20
+
+
+class FrameError(ValueError):
+    """A frame violated the codec (oversized or negative length)."""
+
+
+def encode_frame(payload: bytes) -> bytes:
+    """One wire frame: 4-byte big-endian payload length + payload."""
+    if len(payload) > MAX_FRAME_BYTES:
+        raise FrameError(
+            f"frame payload of {len(payload)} bytes exceeds the "
+            f"{MAX_FRAME_BYTES}-byte ceiling")
+    return _HEADER.pack(len(payload)) + payload
+
+
+class FrameDecoder:
+    """Incremental frame parser: `feed(chunk)` returns every payload the
+    chunk completed, buffering partial frames (header or body) across
+    calls. One instance per connection; no thread safety needed (each
+    connection is owned by one event loop)."""
+
+    __slots__ = ("_buf", "_need", "bytes_in")
+
+    def __init__(self):
+        self._buf = bytearray()
+        self._need = None  # payload length once the header is complete
+        self.bytes_in = 0  # total raw bytes fed (transport accounting)
+
+    def feed(self, chunk: bytes) -> List[bytes]:
+        self.bytes_in += len(chunk)
+        self._buf += chunk
+        out: List[bytes] = []
+        while True:
+            if self._need is None:
+                if len(self._buf) < HEADER_BYTES:
+                    return out
+                (self._need,) = _HEADER.unpack_from(self._buf)
+                if self._need > MAX_FRAME_BYTES:
+                    raise FrameError(
+                        f"incoming frame claims {self._need} bytes "
+                        f"(ceiling {MAX_FRAME_BYTES})")
+                del self._buf[:HEADER_BYTES]
+            if len(self._buf) < self._need:
+                return out
+            out.append(bytes(self._buf[:self._need]))
+            del self._buf[:self._need]
+            self._need = None
+
+    def pending_bytes(self) -> int:
+        """Bytes buffered but not yet yielded (diagnostics)."""
+        return len(self._buf)
+
+
+# -- payloads: the sim wire codec, unchanged ---------------------------------
+
+def encode_message(message) -> bytes:
+    """Serialize one envelope/request through the sim's wire codec (value
+    copy at send time -- see sim/wire.py)."""
+    return wire.encode(message)
+
+
+def decode_message(payload: bytes):
+    return wire.decode(payload)
+
+
+def encode_envelope(message) -> bytes:
+    """encode_message + framing in one step (the common send path)."""
+    return encode_frame(encode_message(message))
+
+
+# -- newline-delimited JSON (the maelstrom stdio protocol) -------------------
+
+class LineDecoder:
+    """FrameDecoder's newline-delimited sibling: feed raw chunks, get back
+    complete non-empty lines (bytes, newline stripped). Partial lines stay
+    buffered until their terminator arrives."""
+
+    __slots__ = ("_buf",)
+
+    def __init__(self):
+        self._buf = b""
+
+    def feed(self, chunk: bytes) -> Iterator[bytes]:
+        self._buf += chunk
+        while b"\n" in self._buf:
+            line, self._buf = self._buf.split(b"\n", 1)
+            line = line.strip()
+            if line:
+                yield line
+
+
+def encode_json_line(packet: dict) -> bytes:
+    """One maelstrom stdio frame: compact JSON + newline."""
+    return (json.dumps(packet) + "\n").encode()
+
+
+def decode_json_line(line: bytes) -> dict:
+    return json.loads(line)
+
+
+def json_clone(packet: dict) -> dict:
+    """Value-copy a packet through the JSON codec (the in-process maelstrom
+    router's serialization fence: anything not actually JSON-serializable
+    fails here, exactly as it would on the real stdio boundary)."""
+    return decode_json_line(encode_json_line(packet))
