@@ -397,4 +397,10 @@ GLOSSARY: Dict[str, str] = {
     "loadgen.errors": "txns answered with an error reply",
     "loadgen.lost": "txns with unknown outcome (timeout or dead connection)",
     "loadgen.latency_us": "client-observed commit latency per acknowledged txn",
+    # -- cluster-tick engine (sim/mesh_burn.ClusterTickEngine.snapshot(),
+    #    folded into the burn report's counters) ------------------------------
+    "node_lane_dispatches": "merged node-lane device dispatches (key + range) across cluster ticks",
+    "nodes_per_dispatch": "mean distinct nodes whose plans rode one merged dispatch",
+    "node_pad_fraction": "share of merged subject rows that were node-tier padding",
+    "mesh_tick_fallbacks": "plans launched per-node because no merge inputs were recorded",
 }

@@ -170,6 +170,15 @@ class CmdPlane:
     bench mode): the arena IS the state -- empty-deps promotions
     (STABLE -> READY_TO_EXECUTE, PRE_APPLIED -> APPLIED + durability merge)
     run on device via cmd_tick(promote=True).
+
+    authoritative=True (the cluster-tick mode, ClusterConfig
+    `cmd_plane_authoritative`): device promotions run even WITH the store
+    attached -- the arena decides status transitions and the host residuals
+    only replay the side effects the device cannot hold (Command objects,
+    cfks, wait graphs). Safe because cmd_tick's predicates are >=-band
+    status compares, so arena rows running ahead of the store (STABLE ->
+    READY_TO_EXECUTE, PRE_APPLIED -> APPLIED) never change a decision;
+    `tests/test_cmd_plane.py` gates this differentially.
     """
 
     dispatches = RegCounter("cmd_plane_dispatches")
@@ -181,10 +190,12 @@ class CmdPlane:
     flush_s = RegTimer("cmd_plane_flush_s")
 
     def __init__(self, store, initial_cap: int = 1024, key_cap: int = 1024,
-                 kpad: int = 4, apply_to_store: bool = True):
+                 kpad: int = 4, apply_to_store: bool = True,
+                 authoritative: bool = False):
         self.store = store
         self.kpad = int(kpad)
         self.apply_to_store = bool(apply_to_store)
+        self.authoritative = bool(authoritative)
         self.metrics = MetricsRegistry()
         self._lock = threading.RLock()
 
@@ -570,7 +581,7 @@ class CmdPlane:
             jnp.asarray(op_klast), jnp.int32(node.epoch),
             jnp.int32(lane2_clean), jnp.int32(lane2_rej),
             jnp.int32(int(Durability.LOCAL)),
-            promote=not self.apply_to_store)
+            promote=(not self.apply_to_store) or self.authoritative)
         (n_status, n_flags, n_promised, n_accepted, n_ea, n_dur,
          n_kmax, n_kvalid, n_clock, out_code, out_ts, out_status,
          csum) = out

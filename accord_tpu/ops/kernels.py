@@ -1193,4 +1193,19 @@ def jit_cache_sizes() -> dict:
         "kid_word_scatter": kid_word_scatter._cache_size(),
         "fused_execution_frontier": fused_execution_frontier._cache_size(),
         "cmd_tick": cmd_tick._cache_size(),
+        # node-lane (cluster-on-mesh burn) kernels live in ops/node_lane,
+        # which imports from this module -- resolve lazily to avoid a cycle
+        **_node_lane_cache_sizes(),
     }
+
+
+def _node_lane_cache_sizes() -> dict:
+    import sys
+    mod = sys.modules.get("accord_tpu.ops.node_lane")
+    if mod is None:
+        # not imported -> nothing compiled -> all zero (reported anyway so
+        # bench deltas stay keyed consistently)
+        return {"node_fused_deps_resolve": 0,
+                "node_fused_range_deps_resolve": 0,
+                "lane_slice": 0}
+    return mod.node_lane_cache_sizes()

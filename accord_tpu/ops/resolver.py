@@ -131,7 +131,8 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
            exec_caps=(), out_tiers=(), range_out_tiers=None,
            kid_cap: int = 4096, cmd_caps=(), cmd_key_caps=(1024,),
            cmd_kpad: int = 4, cmd_op_tiers=None,
-           cmd_promote_modes=(False,)) -> None:
+           cmd_promote_modes=(False,),
+           node_tiers=(), node_batch_tiers=None) -> None:
     """Pre-compile the jit shape tiers the async pipeline uses (first
     compilation costs seconds on a tunnelled TPU; production would do the
     same at process start). The jit cache is process-global, so one call
@@ -160,7 +161,15 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
     cmd_tick and its lane scatters across every (arena cap, key cap,
     op tier, promote mode) in use -- the same coverage
     ops.cmd_plane.warmup_cmd_plane provides standalone, folded in here so
-    one warmup call covers deps + exec + cmd kernels."""
+    one warmup call covers deps + exec + cmd kernels. `node_tiers` (opt-in)
+    warms the cluster-tick node-lane kernels (ops/node_lane.py) across
+    every (block-count tier x merged-row tier x nnz tier): with resolvers
+    built at `pad_node_tiers` matching, node-count churn (crashes,
+    membership change) then pads to pre-compiled shapes and causes zero
+    steady-state recompiles. `node_batch_tiers` overrides the merged-row
+    ladder (default: the first NODE_SUBJECT_TIERS rungs); the tiny span
+    demux (`lane_slice`) compiles per span shape on first use and is
+    excluded from strict recompile gates."""
     import jax.numpy as jnp
     from accord_tpu.ops.kernels import (NNZ_TIERS, SCATTER_NNZ_TIERS,
                                         arena_scatter, arena_scatter_keys,
@@ -279,6 +288,29 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
             op_tiers=(CMD_OP_TIERS if cmd_op_tiers is None
                       else tuple(cmd_op_tiers)),
             promote_modes=tuple(cmd_promote_modes))
+    if node_tiers:
+        from accord_tpu.ops.node_lane import (NODE_SUBJECT_TIERS,
+                                              node_fused_deps_resolve,
+                                              node_fused_range_deps_resolve)
+        nb_tiers = (tuple(node_batch_tiers) if node_batch_tiers is not None
+                    else NODE_SUBJECT_TIERS[:2])
+        for nblk in node_tiers:
+            slots = jnp.arange(nblk, dtype=jnp.int32)
+            arenas = tuple((bm, ts, kd, vl) for _ in range(nblk))
+            rarenas = tuple((rs, re_, rts, rkd, rvl) for _ in range(nblk))
+            for b in nb_tiers:
+                sb = jnp.zeros((b, 3), jnp.int32)
+                sknd = jnp.zeros(b, jnp.int32)
+                srng = jnp.zeros(b, bool)
+                snode = jnp.zeros(b, jnp.int32)
+                for z in nnz_tiers:
+                    of = jnp.full(z, b, jnp.int32)
+                    zz = jnp.zeros(z, jnp.int32)
+                    out = node_fused_deps_resolve(of, zz, snode, sb, sknd,
+                                                  slots, arenas, table)
+                    out = node_fused_range_deps_resolve(
+                        of, zz, zz, snode, sb, sknd, srng, slots, rarenas,
+                        slots, arenas, table)
     if out is not None:
         import jax
         jax.block_until_ready(out)
@@ -1481,7 +1513,8 @@ class _Plan:
     harvest."""
 
     __slots__ = ("items", "groups", "key_call", "range_call", "empty",
-                 "fin_calls", "rfin_calls", "kfin_calls", "want")
+                 "fin_calls", "rfin_calls", "kfin_calls", "want",
+                 "key_args", "range_args")
 
     def __init__(self, items: List[_Item], groups: List[_Group],
                  empty: bool = False):
@@ -1490,6 +1523,13 @@ class _Plan:
         self.key_call = None        # () -> packed, or None
         self.range_call = None      # () -> (rpacked, kpacked), or None
         self.empty = empty
+        # node-lane merge inputs (ops/node_lane.py): the EXACT arrays the
+        # deferred calls above would feed their kernels, recorded only when
+        # a cluster tick_driver is attached -- the mesh-burn engine stacks
+        # them across nodes and swaps key_call/range_call for demux slices
+        # of the merged result
+        self.key_args = None
+        self.range_args = None
         # finalize_on_device: deferred finalize kernel launches per group --
         # the key call consumes the packed result, the range call closes
         # over its group's interval-arena snapshot
@@ -1577,7 +1617,8 @@ class BatchDepsResolver(DepsResolver):
                  retry_limit: int = 2,
                  watchdog_probes: int = 3,
                  watchdog_wall_s: Optional[float] = None,
-                 health_config: Optional[dict] = None):
+                 health_config: Optional[dict] = None,
+                 pad_node_tiers=None):
         # the registry backing every bench counter below (the class-level
         # RegCounter/RegTimer descriptors write through to it), BEFORE any
         # counter touch
@@ -1670,6 +1711,15 @@ class BatchDepsResolver(DepsResolver):
         self.watchdog_wall_s = watchdog_wall_s
         self.health_config = health_config
         self._health: Dict[int, "DeviceHealth"] = {}
+        # cluster-on-mesh burn (sim/mesh_burn.py): when a ClusterTickEngine
+        # attaches itself here, tick scheduling routes through it (one
+        # cluster-wide tick event instead of per-node once() arms) and
+        # _encode_plan records each plan's kernel inputs for the node-lane
+        # merge; pad_node_tiers is the block-count ladder the merge pads to
+        # (None -> node_lane.NODE_BLOCK_TIERS) so node churn never mints a
+        # new jit tier
+        self.tick_driver = None
+        self.pad_node_tiers = pad_node_tiers
 
     @property
     def host_hidden_pct(self) -> float:
@@ -1933,6 +1983,13 @@ class BatchDepsResolver(DepsResolver):
     def _schedule_tick(self, store) -> None:
         node = store.node
         self._windows[id(node)] = store.batch_window_ms
+        if self.tick_driver is not None:
+            # cluster-on-mesh burn: the engine owns tick scheduling (one
+            # cluster-wide event fires every pending node's tick in node-id
+            # order -- see sim/mesh_burn.ClusterTickEngine)
+            self.tick_driver.note_work(
+                self, node, self._window(node, store.batch_window_ms))
+            return
         if id(node) in self._ticking:
             return
         self._ticking.add(id(node))
@@ -1942,6 +1999,11 @@ class BatchDepsResolver(DepsResolver):
     def _arm_tick(self, node) -> None:
         """Self-arm the next tick so staged plans launch even when no new
         enqueue arrives to schedule one."""
+        if self.tick_driver is not None:
+            self.tick_driver.note_work(
+                self, node,
+                self._window(node, self._windows.get(id(node)) or 0.0))
+            return
         if id(node) in self._ticking:
             return
         self._ticking.add(id(node))
@@ -2267,6 +2329,12 @@ class BatchDepsResolver(DepsResolver):
                     lambda ksnap=ksnap, j_of=j_of, j_keys=j_keys,
                     j_sb=j_sb, j_sknd=j_sknd:
                     self._run_kernel(ksnap, j_of, j_keys, j_sb, j_sknd))
+                if self.tick_driver is not None:
+                    plan.key_args = dict(
+                        sb=sb, sknd=sknd, subj_store=subj_store,
+                        subj_of=subj_of, subj_keys=subj_keys,
+                        ngroups=len(groups), slots=[0], ksnaps=[ksnap],
+                        fused=False)
             else:
                 slots = np.fromiter((gi for gi, _ in k_parts), np.int64,
                                     len(k_parts)).astype(np.int32)
@@ -2286,6 +2354,13 @@ class BatchDepsResolver(DepsResolver):
                     j_keys=j_keys, j_store=j_store, j_sb=j_sb, j_sknd=j_sknd:
                     self._run_fused_kernel(ksnaps, j_slots, j_of, j_keys,
                                            j_store, j_sb, j_sknd))
+                if self.tick_driver is not None:
+                    plan.key_args = dict(
+                        sb=sb, sknd=sknd, subj_store=subj_store,
+                        subj_of=subj_of, subj_keys=subj_keys,
+                        ngroups=len(groups),
+                        slots=[gi for gi, _ in k_parts], ksnaps=list(ksnaps),
+                        fused=True, pad_tier=self.pad_store_tiers)
         if self.finalize_on_device and k_parts:
             # per-store finalize_csr plan: consumes the packed result at
             # launch time, so it rides the same deferred-call pipeline
@@ -2322,6 +2397,13 @@ class BatchDepsResolver(DepsResolver):
                     j_sknd=j_sknd, j_srng=j_srng:
                     self._run_range_kernel(rsnap, ksnap, j_iv[0], j_iv[1],
                                            j_iv[2], j_sb, j_sknd, j_srng))
+                if self.tick_driver is not None:
+                    plan.range_args = dict(
+                        iv_of=iv_of, iv_s=iv_s, iv_e=iv_e, sb=sb, sknd=sknd,
+                        srng=srng, subj_store=subj_store,
+                        ngroups=len(groups), r_slots=[0], rsnaps=[rsnap],
+                        k_slots=[0], ksnaps=[ksnap], has_r=True, has_k=True,
+                        fused=False)
             else:
                 r_slots = np.fromiter((gi for gi, _ in r_parts), np.int64,
                                       len(r_parts)).astype(np.int32)
@@ -2355,6 +2437,16 @@ class BatchDepsResolver(DepsResolver):
                     return (rp if has_r else None, kp if has_k else None)
 
                 plan.range_call = range_call
+                if self.tick_driver is not None:
+                    plan.range_args = dict(
+                        iv_of=iv_of, iv_s=iv_s, iv_e=iv_e, sb=sb, sknd=sknd,
+                        srng=srng, subj_store=subj_store,
+                        ngroups=len(groups),
+                        r_slots=[gi for gi, _ in r_parts],
+                        rsnaps=list(rsnaps),
+                        k_slots=[gi for gi, _ in h_parts],
+                        ksnaps=list(ksnaps), has_r=has_r, has_k=has_k,
+                        fused=True, pad_tier=self.pad_store_tiers)
             if self.finalize_on_device:
                 self._plan_range_finalize(plan, groups, grents, givs, nv,
                                           j_iv, j_sb, j_sknd)
@@ -3728,14 +3820,16 @@ class ShardedBatchDepsResolver(BatchDepsResolver):
                  pad_store_tiers: Optional[int] = None,
                  finalize_on_device: bool = True,
                  adaptive_window: bool = False, kid_cap: int = 4096,
-                 device_out_bound: bool = True):
+                 device_out_bound: bool = True,
+                 pad_node_tiers=None):
         super().__init__(num_buckets, initial_cap,
                          fuse_cross_store=fuse_cross_store,
                          overlap_host=overlap_host,
                          pad_store_tiers=pad_store_tiers,
                          finalize_on_device=finalize_on_device,
                          adaptive_window=adaptive_window, kid_cap=kid_cap,
-                         device_out_bound=device_out_bound)
+                         device_out_bound=device_out_bound,
+                         pad_node_tiers=pad_node_tiers)
         from accord_tpu.parallel.mesh import make_mesh
         self.mesh = mesh if mesh is not None else make_mesh()
         data = self.mesh.shape["data"]

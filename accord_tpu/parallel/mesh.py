@@ -431,6 +431,40 @@ def sharded_fused_range_deps_resolve(mesh: Mesh, nr: int, nk: int):
     return call
 
 
+def sharded_node_tick(mesh: Mesh, key_merge, range_merge, table):
+    """Multi-chip twin of the node-lane cluster tick (ops/node_lane.py):
+    evaluate a whole cluster's merged key/range deps-resolve dispatches on
+    the mesh, sharding the node-major BLOCK axis over 'data' rows and
+    reusing the existing 'model'-axis kid-table sharding. The merged
+    node-lane inputs are exactly a fused cross-store call with more blocks
+    and a node-qualified slot space, so this delegates to the lru-cached
+    sharded fused kernels at the merge's block-count tier -- same math,
+    same `_concat_lane_blocks` readback layout, so the engine's per-plan
+    span demux is unchanged. Returns (packed, rpacked, kpacked), any of
+    them None when that merge is absent."""
+    packed = rpacked = kpacked = None
+    if key_merge is not None and key_merge.blocks:
+        kern = sharded_fused_deps_resolve(mesh, len(key_merge.blocks))
+        packed = kern(
+            jnp.asarray(key_merge.subj_of), jnp.asarray(key_merge.subj_keys),
+            jnp.asarray(key_merge.subj_node), jnp.asarray(key_merge.sb),
+            jnp.asarray(key_merge.sknd), jnp.asarray(key_merge.slots),
+            key_merge.blocks, table)
+    if range_merge is not None \
+            and (range_merge.r_blocks or range_merge.k_blocks):
+        kern = sharded_fused_range_deps_resolve(
+            mesh, len(range_merge.r_blocks), len(range_merge.k_blocks))
+        rpacked, kpacked = kern(
+            jnp.asarray(range_merge.iv_of), jnp.asarray(range_merge.iv_s),
+            jnp.asarray(range_merge.iv_e),
+            jnp.asarray(range_merge.subj_node),
+            jnp.asarray(range_merge.sb), jnp.asarray(range_merge.sknd),
+            jnp.asarray(range_merge.srng), jnp.asarray(range_merge.r_slots),
+            range_merge.r_blocks, jnp.asarray(range_merge.k_slots),
+            range_merge.k_blocks, table)
+    return packed, rpacked, kpacked
+
+
 @functools.lru_cache(maxsize=8)
 def sharded_finalize_csr(mesh: Mesh):
     """Mesh-sharded twin of ops.kernels.finalize_csr: the finalized-CSR
@@ -581,7 +615,9 @@ def warmup_sharded(mesh: Mesh, num_buckets: int = 256, cap: int = 4096,
                    cmd_key_caps: Tuple[int, ...] = (1024,),
                    cmd_kpad: int = 4,
                    cmd_op_tiers: Optional[Tuple[int, ...]] = None,
-                   cmd_promote_modes: Tuple[bool, ...] = (False,)) -> None:
+                   cmd_promote_modes: Tuple[bool, ...] = (False,),
+                   node_tiers: Tuple[int, ...] = (),
+                   node_batch_tiers: Optional[Tuple[int, ...]] = None) -> None:
     """Pre-compile the sharded hot kernels' (batch tier, nnz tier, store
     tier) jit cross product (the sharded twin of ops.resolver.warmup; same
     padding ladders the overlapped pipeline dispatches). Store tiers >= 2
@@ -595,7 +631,11 @@ def warmup_sharded(mesh: Mesh, num_buckets: int = 256, cap: int = 4096,
     jit caches by shape. `cmd_caps` (opt-in) folds in the device
     coordination plane's warmup (cmd_tick + its lane scatters) -- the cmd
     arena is store-local and replicated, so the single-device variants are
-    the ones a sharded deployment dispatches too."""
+    the ones a sharded deployment dispatches too. `node_tiers` (opt-in)
+    warms the cluster-tick node-lane path (`sharded_node_tick` delegates to
+    the fused kernels at the merge's block-count tier) across every
+    (block tier x merged-row tier x nnz tier) -- the sharded twin of
+    ops.resolver.warmup's node_tiers."""
     from accord_tpu.ops.encoding import WITNESS_TABLE
     from accord_tpu.ops.kernels import NNZ_TIERS
     if nnz_tiers is None:
@@ -665,6 +705,28 @@ def warmup_sharded(mesh: Mesh, num_buckets: int = 256, cap: int = 4096,
             op_tiers=(CMD_OP_TIERS if cmd_op_tiers is None
                       else cmd_op_tiers),
             promote_modes=cmd_promote_modes)
+    if node_tiers:
+        from accord_tpu.ops.node_lane import NODE_SUBJECT_TIERS
+        nb_tiers = (tuple(node_batch_tiers) if node_batch_tiers is not None
+                    else NODE_SUBJECT_TIERS[:2])
+        for nblk in node_tiers:
+            fkern = sharded_fused_deps_resolve(mesh, nblk)
+            frkern = sharded_fused_range_deps_resolve(mesh, nblk, nblk)
+            slots = jnp.arange(nblk, dtype=jnp.int32)
+            arenas = tuple((bm, ts, kinds, valid) for _ in range(nblk))
+            rarenas = tuple((rs, re_, rts, rkd, rvl) for _ in range(nblk))
+            for b in nb_tiers:
+                sb = jnp.zeros((b, 3), jnp.int32)
+                sknd = jnp.zeros(b, jnp.int32)
+                srng = jnp.zeros(b, bool)
+                snode = jnp.zeros(b, jnp.int32)
+                for z in nnz_tiers:
+                    of = jnp.full(z, b, jnp.int32)
+                    zz = jnp.zeros(z, jnp.int32)
+                    out = fkern(of, zz, snode, sb, sknd, slots, arenas,
+                                table)
+                    out = frkern(of, zz, zz, snode, sb, sknd, srng, slots,
+                                 rarenas, slots, arenas, table)
     if out is not None:
         jax.block_until_ready(out)
 

@@ -70,9 +70,14 @@ class ServeConfig:
                  max_inflight: int = 256,
                  metrics_interval_s: float = 10.0,
                  drain_timeout_s: float = 10.0,
-                 warmup: bool = True):
+                 warmup: bool = True,
+                 bind_host: Optional[str] = None):
         self.node_id = node_id
         self.listen = listen
+        # the socket binds bind_host when set (e.g. "0.0.0.0" so peers on
+        # other hosts can reach us); `listen` stays the ADVERTISED address
+        # peers dial. None = bind the advertised host (loopback in CI).
+        self.bind_host = bind_host
         self.peers = dict(peers)  # includes self or not; self is ignored
         self.num_stores = num_stores
         self.batch_window_ms = batch_window_ms
@@ -489,8 +494,10 @@ class NodeServer:
             self.warm_kernels()
             self.log("warmup done in %.1fs" % (time.monotonic() - t0))
         host, port = self.cfg.listen
-        self._server = await asyncio.start_server(self._on_client, host, port)
-        self.log(f"serving node {self.cfg.node_id} on {host}:{port}")
+        bind = self.cfg.bind_host or host
+        self._server = await asyncio.start_server(self._on_client, bind, port)
+        self.log(f"serving node {self.cfg.node_id} on {bind}:{port}"
+                 + (f" (advertised {host})" if bind != host else ""))
         ticker = self._loop.create_task(self._ticker())
         try:
             await self._stopping.wait()
@@ -519,7 +526,12 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="serve one accord node over the socket transport")
     ap.add_argument("--node-id", type=int, required=True)
-    ap.add_argument("--listen", required=True, help="host:port to bind")
+    ap.add_argument("--listen", required=True,
+                    help="host:port peers dial (the advertised address)")
+    ap.add_argument("--bind-host", default=None,
+                    help="interface to bind instead of the advertised host "
+                         "(e.g. 0.0.0.0 for multi-host clusters; default: "
+                         "the --listen host)")
     ap.add_argument("--peers", required=True,
                     help="comma list id=host:port (all nodes incl. self)")
     ap.add_argument("--num-stores", type=int, default=1)
@@ -548,7 +560,8 @@ def main(argv=None) -> int:
         max_inflight=args.max_inflight,
         metrics_interval_s=args.metrics_interval_s,
         warmup=not args.no_warmup,
-        rpc_timeout_ms=args.rpc_timeout_ms)
+        rpc_timeout_ms=args.rpc_timeout_ms,
+        bind_host=args.bind_host)
     server = NodeServer(cfg)
 
     async def _run():

@@ -39,6 +39,7 @@ class ClusterConfig:
                  exec_fuse: bool = True,
                  cmd_plane: bool = False, cmd_plane_cap: int = 1024,
                  cmd_plane_key_cap: int = 1024,
+                 cmd_plane_authoritative: bool = False,
                  store_delays: bool = False, store_delay_max_us: int = 2000,
                  clock_drift: bool = False, clock_offset_max_us: int = 100_000,
                  clock_drift_max_ppm: int = 10_000):
@@ -88,6 +89,11 @@ class ClusterConfig:
         self.cmd_plane = cmd_plane
         self.cmd_plane_cap = cmd_plane_cap
         self.cmd_plane_key_cap = cmd_plane_key_cap
+        # PR 12's arena-authoritative mode as a cluster flag: device
+        # promotions decide status transitions even with the store attached;
+        # Python handlers are consulted only for ops the device cannot
+        # decide (see CmdPlane.authoritative)
+        self.cmd_plane_authoritative = cmd_plane_authoritative
         # adversarial simulator knobs (reference: DelayedCommandStores async
         # loads + per-node clock drift, burn/BurnTest.java:330-340)
         self.store_delays = store_delays
@@ -333,7 +339,8 @@ class Cluster:
             for store in node.command_stores.all():
                 store.cmd_plane = CmdPlane(
                     store, initial_cap=self.config.cmd_plane_cap,
-                    key_cap=self.config.cmd_plane_key_cap)
+                    key_cap=self.config.cmd_plane_key_cap,
+                    authoritative=self.config.cmd_plane_authoritative)
         if self.config.store_delays:
             # async store-op delays (reference: DelayedCommandStores): each
             # store defers every op by a deterministic random delay,

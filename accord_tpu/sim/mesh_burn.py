@@ -1,0 +1,332 @@
+"""Cluster-on-mesh burn: node id as a batch axis (ROADMAP item 2).
+
+The stock burn (sim/burn.py) ticks each node's resolver from its own
+scheduler event, so a cluster tick costs one device dispatch PER NODE and
+cluster scale is bounded by host single-thread dispatch overhead no matter
+how fast the kernels run. This module lifts PR 4's store-id-lane fusion one
+level up: a ClusterTickEngine takes over tick scheduling for every node's
+resolver (resolver.tick_driver), drains and encodes each pending node
+host-side exactly as before, then stacks every node's encoded dispatch
+plans into ONE node-major device call per cluster tick (ops/node_lane.py)
+-- key/range arena lane blocks under globally unique (plan, store) slots, a
+traced `subj_node` routing lane, one contiguous packed readback demuxed by
+per-plan word spans (the `_Group` row-offset-table pattern).
+
+Determinism and differential testing: the sim network, scheduler, fault
+planes, and every host-side protocol decision are untouched -- the engine
+replaces only WHERE the resolve kernels run. Both engine modes share one
+event schedule, so `mesh_tick=True` (node-lane merged dispatch) commits
+bit-identical histories to `mesh_tick=False` (the per-node Python launch
+loop over the same plans), and `--reconcile` holds in both. The merged
+kernel's per-plan output slices are bit-identical to the per-plan kernel
+calls by construction (exact 0/1 bf16 integer products, per-block slot
+masks, 32-aligned word spans, baseline `_pad_fused` padding replicated
+inside each plan's span -- see ops/node_lane.py).
+
+Scope note: the merged dispatch covers the deps-resolve kernels (the
+per-tick dispatch that scales with node count). Finalize-CSR compaction
+launches ride the same host event per plan group against the merged
+result's demuxed spans, and cmd_tick spans keep firing synchronously inside
+each node's drain -- folding those two into the same device call is the
+remaining ROADMAP item 1/2 carry-over.
+
+CLI:  python -m accord_tpu.sim.mesh_burn --seed 1 --ops 500 --nodes 8
+      [--python-loop]  per-node launch loop (the differential baseline)
+      [--reconcile]    run each seed twice; require identical event logs
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from accord_tpu.sim.burn import BurnReport, run_burn
+from accord_tpu.sim.cluster import ClusterConfig
+
+
+class ClusterTickEngine:
+    """Owns tick scheduling for every adopted resolver: one cluster-wide
+    tick event replaces the per-node `scheduler.once` arms, and each firing
+    drains + stages every pending node in node-id order, then launches all
+    plans -- through one merged node-lane dispatch (mesh_tick=True) or the
+    per-node loop (mesh_tick=False, the bit-identical baseline).
+
+    The engine discovers the shared PendingQueue from the first noting
+    node's scheduler and arms its tick on the RAW queue (not a
+    NodeScheduler), so one node's crash cannot kill the cluster tick; dead
+    nodes are skipped at fire time via their scheduler's alive cell, which
+    is exactly the baseline's NodeScheduler-guard semantics."""
+
+    def __init__(self, mesh_tick: bool = True):
+        self.mesh_tick = mesh_tick
+        self._pending: Dict[tuple, tuple] = {}
+        self._armed = False
+        self._queue = None
+        # registry counters (folded into the burn report / bench JSON; see
+        # obs/metrics.GLOSSARY)
+        self.cluster_ticks = 0
+        self.node_lane_dispatches = 0
+        self.mesh_tick_fallbacks = 0
+        # per-plan deferred kernel calls staged this run -- in loop mode
+        # each is one device dispatch; in mesh mode they collapse into
+        # node_lane_dispatches (bench reads this attribute directly; it
+        # is not a glossary counter)
+        self.plan_kernel_launches = 0
+        self._nodes_in_dispatches = 0
+        self._rows_used = 0
+        self._rows_total = 0
+
+    def adopt(self, resolver):
+        """Attach this engine as the resolver's tick driver (wrap the
+        cluster's deps_resolver_factory with this so restarts' fresh
+        resolvers re-attach automatically)."""
+        resolver.tick_driver = self
+        return resolver
+
+    def snapshot(self) -> Dict[str, float]:
+        n = self.node_lane_dispatches
+        return {
+            "cluster_ticks": self.cluster_ticks,
+            "node_lane_dispatches": n,
+            "nodes_per_dispatch": (self._nodes_in_dispatches / n) if n else 0.0,
+            "node_pad_fraction": (
+                (self._rows_total - self._rows_used) / self._rows_total
+                if self._rows_total else 0.0),
+            "mesh_tick_fallbacks": self.mesh_tick_fallbacks,
+        }
+
+    # -- resolver hook ----------------------------------------------------
+    def note_work(self, resolver, node, window_ms: float) -> None:
+        """Called by the resolver in place of arming its own tick. Dedupes
+        per (resolver, node); the first note after an idle period arms the
+        cluster tick at that node's effective window."""
+        self._queue = node.scheduler.queue
+        key = (id(resolver), id(node))
+        if key not in self._pending:
+            self._pending[key] = (resolver, node)
+        if not self._armed:
+            self._armed = True
+            self._queue.add(int((window_ms or 0.0) * 1000), self._fire)
+
+    # -- the cluster tick -------------------------------------------------
+    def _fire(self) -> None:
+        self._armed = False
+        pend = sorted(self._pending.values(), key=lambda rn: rn[1].id)
+        self._pending = {}
+        if not pend:
+            return
+        self.cluster_ticks += 1
+        staged: List[tuple] = []
+        for res, node in pend:
+            if not node.scheduler.alive[0]:
+                # crashed since noting work: its queued items die with the
+                # incarnation, exactly as the baseline's NodeScheduler
+                # guard would have dropped the armed tick
+                continue
+            items = res._drain_and_preaccept(node)
+            res._adapt(node, len(items))
+            plans = [res._stage(node, sub) for sub in res._slices(items)]
+            if plans:
+                staged.append((res, node, plans))
+        if not staged:
+            return
+        for _res, _node, plans in staged:
+            for plan in plans:
+                self.plan_kernel_launches += (
+                    (plan.key_call is not None)
+                    + (plan.range_call is not None))
+        if self.mesh_tick:
+            self._merged_launch(staged)
+        else:
+            for res, node, plans in staged:
+                for plan in plans:
+                    res._launch(node, plan)
+
+    def _merged_launch(self, staged: List[tuple]) -> None:
+        """Stack every plan's recorded kernel inputs into at most one key
+        and one range node-lane dispatch, swap each plan's deferred calls
+        for demux slices of the merged results, then launch the plans in
+        node-id order -- fault draws, harvest scheduling, and decode all
+        run the stock per-plan path against bit-identical buffers."""
+        from accord_tpu.ops import node_lane as nl
+        res0 = staged[0][0]
+        key_entries: List[tuple] = []
+        rng_entries: List[tuple] = []
+        lane_nodes = set()
+        for res, node, plans in staged:
+            mergeable = res.num_buckets == res0.num_buckets
+            for plan in plans:
+                if not mergeable:
+                    # heterogeneous resolver config: this plan launches its
+                    # own kernels (still correct, just not merged)
+                    if plan.key_call is not None or plan.range_call is not None:
+                        self.mesh_tick_fallbacks += 1
+                    continue
+                if (plan.key_call is not None and plan.key_args is None) or \
+                        (plan.range_call is not None and plan.range_args is None):
+                    self.mesh_tick_fallbacks += 1
+                    continue
+                if plan.key_args is not None:
+                    key_entries.append((plan, plan.key_args))
+                    lane_nodes.add(id(node))
+                if plan.range_args is not None:
+                    rng_entries.append((plan, plan.range_args))
+                    lane_nodes.add(id(node))
+        km = rm = None
+        packed = rpacked = kpacked = None
+        if key_entries:
+            km = nl.build_key_merge(key_entries, res0._pad_key_block,
+                                    res0.pad_node_tiers)
+        if rng_entries:
+            rm = nl.build_range_merge(rng_entries, res0._pad_key_block,
+                                      res0._pad_range_block,
+                                      res0.pad_node_tiers)
+        mesh = getattr(res0, "mesh", None)
+        if mesh is not None:
+            from accord_tpu.parallel.mesh import sharded_node_tick
+            packed, rpacked, kpacked = sharded_node_tick(
+                mesh, km, rm, res0._table)
+        else:
+            if km is not None:
+                packed = nl.run_key_merge(km, res0._table)
+            if rm is not None:
+                rpacked, kpacked = nl.run_range_merge(rm, res0._table)
+        ndisp = (1 if km is not None else 0) + (1 if rm is not None else 0)
+        if ndisp:
+            self.node_lane_dispatches += ndisp
+            self._nodes_in_dispatches += len(lane_nodes) * ndisp
+        for merge in (km, rm):
+            if merge is not None:
+                self._rows_used += merge.rows_used
+                self._rows_total += merge.rows_padded
+        if km is not None:
+            for (plan, _args), (r0, b, wlo, w) in zip(key_entries, km.spans):
+                plan.key_call = (
+                    lambda packed=packed, r0=r0, wlo=wlo, b=b, w=w:
+                    nl.lane_slice(packed, r0, wlo, b, w))
+        if rm is not None:
+            for (plan, args), (r0, b, rwlo, rw, kwlo, kw) \
+                    in zip(rng_entries, rm.spans):
+                def range_call(r0=r0, b=b, rwlo=rwlo, rw=rw, kwlo=kwlo,
+                               kw=kw, has_r=args["has_r"],
+                               has_k=args["has_k"], rp_=rpacked, kp_=kpacked):
+                    rp = nl.lane_slice(rp_, r0, rwlo, b, rw) if has_r else None
+                    kp = nl.lane_slice(kp_, r0, kwlo, b, kw) if has_k else None
+                    return rp, kp
+                plan.range_call = range_call
+        for res, node, plans in staged:
+            for plan in plans:
+                res._launch(node, plan)
+
+
+def run_mesh_burn(seed: int, ops: int = 500, *, nodes: int = 8,
+                  rf: int = 3, num_shards: Optional[int] = None,
+                  stores_per_node: int = 2, mesh_tick: bool = True,
+                  key_count: int = 64, concurrency: int = 16,
+                  batch_window_ms: float = 2.0,
+                  device_latency_ms: float = 4.0,
+                  num_buckets: int = 128,
+                  pad_node_tiers=None,
+                  cmd_plane: bool = False,
+                  cmd_plane_authoritative: bool = False,
+                  resolver_kwargs: Optional[dict] = None,
+                  collect_log: bool = False,
+                  engine: Optional[ClusterTickEngine] = None,
+                  sharded: bool = False,
+                  **burn_kwargs) -> Tuple[BurnReport, ClusterTickEngine]:
+    """Run one seeded burn with the whole cluster ticked by a
+    ClusterTickEngine. mesh_tick=True launches every node's resolve as one
+    node-lane dispatch per cluster tick; mesh_tick=False launches the same
+    plans through the per-node Python loop (the bit-identical baseline).
+    Returns (report, engine) -- the report's counters already carry the
+    engine's node-lane metrics."""
+    from accord_tpu.ops.resolver import BatchDepsResolver
+
+    eng = engine or ClusterTickEngine(mesh_tick=mesh_tick)
+    rkw = dict(resolver_kwargs or {})
+    rkw.setdefault("num_buckets", num_buckets)
+    rkw.setdefault("pad_node_tiers", pad_node_tiers)
+
+    if sharded:
+        from accord_tpu.ops.resolver import ShardedBatchDepsResolver
+        from accord_tpu.parallel.mesh import make_mesh
+        the_mesh = make_mesh()
+
+        def factory():
+            return eng.adopt(ShardedBatchDepsResolver(mesh=the_mesh, **rkw))
+    else:
+        def factory():
+            return eng.adopt(BatchDepsResolver(**rkw))
+
+    cfg = ClusterConfig(
+        num_nodes=nodes, rf=min(rf, nodes),
+        num_shards=num_shards if num_shards is not None else max(4, nodes),
+        stores_per_node=stores_per_node,
+        deps_resolver_factory=factory,
+        deps_batch_window_ms=batch_window_ms,
+        device_latency_ms=device_latency_ms,
+        cmd_plane=cmd_plane,
+        cmd_plane_authoritative=cmd_plane_authoritative)
+    report = run_burn(seed, ops, nodes=nodes, rf=min(rf, nodes),
+                      key_count=key_count, concurrency=concurrency,
+                      config=cfg, collect_log=collect_log, **burn_kwargs)
+    for k, v in eng.snapshot().items():
+        report.counters[k] = v
+    return report, eng
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="accord_tpu cluster-on-mesh burn")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--ops", type=int, default=500)
+    ap.add_argument("--count", type=int, default=1)
+    ap.add_argument("--nodes", type=int, default=8)
+    ap.add_argument("--rf", type=int, default=3)
+    ap.add_argument("--stores-per-node", type=int, default=2)
+    ap.add_argument("--keys", type=int, default=64)
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--range-read-ratio", type=float, default=0.0)
+    ap.add_argument("--range-write-ratio", type=float, default=0.0)
+    ap.add_argument("--crash-restart", action="store_true")
+    ap.add_argument("--cmd-plane", action="store_true")
+    ap.add_argument("--cmd-plane-authoritative", action="store_true")
+    ap.add_argument("--python-loop", action="store_true",
+                    help="per-node launch loop (the differential baseline)")
+    ap.add_argument("--reconcile", action="store_true",
+                    help="run each seed twice; require identical logs")
+    args = ap.parse_args(argv)
+
+    ok = True
+    for seed in range(args.seed, args.seed + args.count):
+        kwargs = dict(
+            ops=args.ops, nodes=args.nodes, rf=args.rf,
+            stores_per_node=args.stores_per_node, key_count=args.keys,
+            concurrency=args.concurrency,
+            range_read_ratio=args.range_read_ratio,
+            range_write_ratio=args.range_write_ratio,
+            crash_restart=args.crash_restart,
+            cmd_plane=args.cmd_plane or args.cmd_plane_authoritative,
+            cmd_plane_authoritative=args.cmd_plane_authoritative,
+            mesh_tick=not args.python_loop)
+        try:
+            r, eng = run_mesh_burn(seed, collect_log=args.reconcile,
+                                   **kwargs)
+            if args.reconcile:
+                r2, _ = run_mesh_burn(seed, collect_log=True, **kwargs)
+                if r.log != r2.log:
+                    print(f"seed {seed}: NON-DETERMINISTIC "
+                          f"({len(r.log)} vs {len(r2.log)} entries)")
+                    ok = False
+                    continue
+            print(json.dumps({"seed": seed, **r.as_dict(),
+                              "deterministic": args.reconcile or None}))
+        except AssertionError as e:
+            print(f"seed {seed}: FAILED: {e}")
+            ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

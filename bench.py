@@ -1429,6 +1429,140 @@ def bench_serve(quick: bool):
 
 
 # ---------------------------------------------------------------------------
+# 5b. mesh burn: node id as a batch axis
+# ---------------------------------------------------------------------------
+
+def bench_mesh_burn(quick: bool):
+    """Cluster-on-mesh burn sweep: same-seed burns at 8/64(/256) nodes,
+    the node-lane merged dispatch vs the per-node Python launch loop.
+    Hard gates per size: the two modes commit BIT-IDENTICAL event logs
+    (so sim time is equal by construction and the comparison is purely
+    about dispatch structure), committed txns per device dispatch clears
+    3x the loop at >= 64 nodes (the loop fires one resolve kernel per
+    node plan; the merge fires at most two per cluster tick -- on
+    dispatch-bound accelerators this collapse IS the committed-txn/s
+    win), and the node-lane kernels mint ZERO compiles in the timed
+    sweep after the warm pass, across every node-count change
+    (`lane_slice` demux is excluded by the documented warmup
+    convention -- it compiles per span shape, not per node count).
+    Wall-clock committed/s for both modes is reported un-gated: on CPU
+    a dispatch is a function call, so the host-side block stacking can
+    outweigh the collapse it buys; the structural ratio is the portable
+    number. A MULTICHIP leg runs the same differential through
+    `sharded_node_tick` on the host's virtual device mesh."""
+    from accord_tpu.ops.kernels import jit_cache_sizes
+    from accord_tpu.ops.resolver import warmup
+    from accord_tpu.sim.mesh_burn import run_mesh_burn
+
+    sizes = ((8, 60), (64, 60)) if quick else ((8, 120), (64, 120), (256, 50))
+    seed = 6
+
+    # node_tiers= pass-through (the warmup satellite): precompile the
+    # node-lane kernels for small block counts before any burn runs, so
+    # the warm pass below mostly exercises workload-shaped tiers
+    warmup(num_buckets=128, cap=4096, batch_tiers=(8,), scatter_tiers=(8,),
+           store_tiers=(1, 2), node_tiers=(2, 4))
+
+    # warm pass: one mesh-tick burn per size, SAME seed/kwargs as the
+    # timed leg, so every node-kernel shape the sweep can reach is
+    # compiled before the snapshot
+    for nodes, ops in sizes:
+        run_mesh_burn(seed, ops, nodes=nodes, mesh_tick=True)
+    cache0 = {k: v for k, v in jit_cache_sizes().items()
+              if k.startswith("node_fused")}
+
+    results = {}
+    for nodes, ops in sizes:
+        t0 = time.perf_counter()
+        mesh, eng = run_mesh_burn(seed, ops, nodes=nodes, mesh_tick=True,
+                                  collect_log=True)
+        mesh_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        loop, leng = run_mesh_burn(seed, ops, nodes=nodes, mesh_tick=False,
+                                   collect_log=True)
+        loop_s = time.perf_counter() - t0
+        if mesh.log != loop.log:
+            raise AssertionError(
+                f"{nodes}-node node-lane burn diverged from the Python "
+                f"loop ({len(mesh.log)} vs {len(loop.log)} entries)")
+        snap = eng.snapshot()
+        # the loop fires one device call per staged plan kernel (key and
+        # range count separately); both modes stage identical plans
+        loop_calls = leng.plan_kernel_launches
+        mesh_calls = (snap["node_lane_dispatches"]
+                      + snap["mesh_tick_fallbacks"])
+        per_dispatch = loop_calls / max(mesh_calls, 1)
+        results[nodes] = {
+            "ops": ops,
+            "acked": mesh.acked,
+            "cluster_ticks": snap["cluster_ticks"],
+            "node_lane_dispatches": snap["node_lane_dispatches"],
+            "loop_device_calls": loop_calls,
+            "nodes_per_dispatch": round(snap["nodes_per_dispatch"], 2),
+            "node_pad_fraction": round(snap["node_pad_fraction"], 3),
+            "mesh_tick_fallbacks": snap["mesh_tick_fallbacks"],
+            "committed_per_dispatch_speedup": round(per_dispatch, 2),
+            "mesh_committed_per_s": round(mesh.acked / max(mesh_s, 1e-9)),
+            "loop_committed_per_s": round(loop.acked / max(loop_s, 1e-9)),
+            "wall_ratio": round((mesh.acked / max(mesh_s, 1e-9))
+                                / max(loop.acked / max(loop_s, 1e-9), 1e-9),
+                                2),
+            "history_identical": True,
+        }
+        if nodes >= 64 and per_dispatch < 3.0:
+            raise AssertionError(
+                f"committed txns per device dispatch at {nodes} nodes only "
+                f"{per_dispatch:.2f}x the per-node loop "
+                f"({loop_calls} loop calls vs {mesh_calls} merged; gate 3x)")
+
+    cache1 = {k: v for k, v in jit_cache_sizes().items()
+              if k.startswith("node_fused")}
+    if cache1 != cache0:
+        raise AssertionError(
+            f"node-lane kernels recompiled across node-count changes in "
+            f"the timed sweep: {cache0} -> {cache1}")
+
+    # MULTICHIP: the same differential through sharded_node_tick (node
+    # blocks over 'data', buckets over 'model'). Virtual devices must be
+    # forced before jax's backend init, so this leg runs in a fresh
+    # process with an 8-device host mesh (the dryrun_multichip pattern).
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8"
+                          ).strip())
+    snippet = (
+        "import json, jax\n"
+        "from accord_tpu.sim.mesh_burn import run_mesh_burn\n"
+        "rkw = dict(num_buckets=256, initial_cap=512)\n"
+        f"kw = dict(nodes=4, sharded=True, collect_log=True,\n"
+        f"          resolver_kwargs=rkw)\n"
+        f"sh, eng = run_mesh_burn({seed}, 40, mesh_tick=True, **kw)\n"
+        f"lp, _ = run_mesh_burn({seed}, 40, mesh_tick=False, **kw)\n"
+        "assert sh.log == lp.log, 'MULTICHIP node-lane burn diverged'\n"
+        "print(json.dumps({'devices': len(jax.devices()),\n"
+        "                  'node_lane_dispatches':\n"
+        "                      eng.snapshot()['node_lane_dispatches'],\n"
+        "                  'history_identical': True}))\n")
+    out = subprocess.run([sys.executable, "-c", snippet], env=env,
+                         capture_output=True, text=True, timeout=900)
+    if out.returncode != 0:
+        raise AssertionError(
+            f"MULTICHIP leg failed: {out.stderr[-800:]}")
+    multichip = json.loads(out.stdout.strip().splitlines()[-1])
+    if multichip["devices"] < 8:
+        raise AssertionError(
+            f"MULTICHIP leg ran on {multichip['devices']} devices")
+
+    return {
+        "seed": seed,
+        "sweep": {str(n): r for n, r in results.items()},
+        "node_kernel_recompiles_in_sweep": 0,    # asserted above
+        "multichip": multichip,
+    }
+
+
+# ---------------------------------------------------------------------------
 # 6. obs overhead: the disabled flight recorder must cost ~nothing
 # ---------------------------------------------------------------------------
 
@@ -1549,6 +1683,7 @@ def main(argv=None) -> int:
         pad_tiers = _traced("pad_tiers", bench_pad_tiers, args.quick)
         exec_plane = _traced("exec_plane", bench_exec_plane, args.quick)
         cmd_plane = _traced("cmd_plane", bench_cmd_plane, args.quick)
+        mesh_burn = _traced("mesh_burn", bench_mesh_burn, args.quick)
         # subprocess leg last: it runs in its OWN processes (each does its
         # own warmup), so the parent's jit caches and trace are untouched
         serve = bench_serve(args.quick)
@@ -1570,6 +1705,7 @@ def main(argv=None) -> int:
                 "pad_store_tiers": pad_tiers,
                 "exec_plane": exec_plane,
                 "cmd_plane": cmd_plane,
+                "mesh_burn": mesh_burn,
                 "serve": serve,
                 "obs_overhead": obs_overhead,
             },

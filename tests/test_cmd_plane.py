@@ -255,6 +255,23 @@ def test_burn_differential_contended():
     assert host.log == dev.log
 
 
+def test_burn_differential_authoritative():
+    """The `cmd_plane_authoritative` cluster flag: device promotions decide
+    status transitions WITH the store attached (host handlers replay side
+    effects only). The promotion predicates are >=-band status compares, so
+    arena rows running ahead of the store must never change a decision --
+    the burn history stays bit-identical to the host baseline."""
+    from accord_tpu.sim.burn import run_burn
+    kw = dict(ops=60, write_ratio=0.85, key_count=6, collect_log=True)
+    host = run_burn(7, config=ClusterConfig(), **kw)
+    auth = run_burn(7, config=ClusterConfig(
+        cmd_plane=True, cmd_plane_authoritative=True), **kw)
+    assert host.acked == auth.acked == 60
+    assert host.log == auth.log, \
+        "authoritative cmd_plane burn diverged from host burn"
+    assert auth.counters.get("cmd_plane_dispatches", 0) > 0
+
+
 def test_warmup_zero_recompiles():
     """After warmup_cmd_plane at the exact arena/op tiers, a live workload
     mints no new cmd_tick compiles (the bench's recompile gate)."""

@@ -1,0 +1,140 @@
+"""Cluster-on-mesh burn tests: the node-lane merged dispatch
+(sim/mesh_burn.py + ops/node_lane.py) against the per-node Python launch
+loop. Both modes share one event schedule (the ClusterTickEngine drains,
+stages, and launches every pending node either way), so the differential
+is exact: bit-identical event logs, not statistical agreement.
+"""
+from __future__ import annotations
+
+import pytest
+
+from accord_tpu.sim.mesh_burn import ClusterTickEngine, run_mesh_burn
+
+pytestmark = pytest.mark.mesh_burn
+
+
+def _logs(seed, ops, **kw):
+    mesh, emesh = run_mesh_burn(seed, ops, mesh_tick=True,
+                                collect_log=True, **kw)
+    loop, _ = run_mesh_burn(seed, ops, mesh_tick=False,
+                            collect_log=True, **kw)
+    return mesh, emesh, loop
+
+
+def test_mesh_vs_loop_differential_small():
+    """Key + range traffic at 4 nodes: the merged node-lane dispatch
+    commits the exact event log of the per-node launch loop, and every
+    plan rode the merge (no fallbacks)."""
+    mesh, eng, loop = _logs(11, 90, nodes=4,
+                            range_read_ratio=0.15, range_write_ratio=0.1)
+    assert mesh.acked == loop.acked == 90
+    assert mesh.log == loop.log, "node-lane burn diverged from Python loop"
+    snap = eng.snapshot()
+    assert snap["node_lane_dispatches"] > 0
+    assert snap["mesh_tick_fallbacks"] == 0
+    assert snap["nodes_per_dispatch"] > 1.0, \
+        "merge never carried more than one node"
+
+
+def test_randomized_differential_seeds():
+    """A seed sweep of the plain workload: determinism and equivalence are
+    properties of the engine, not of one lucky schedule."""
+    for seed in (2, 5, 8):
+        mesh, _eng, loop = _logs(seed, 50, nodes=3)
+        assert mesh.log == loop.log, f"diverged at seed {seed}"
+
+
+@pytest.mark.slow
+def test_mesh_vs_loop_differential_64_nodes_reconcile():
+    """The acceptance-bar case: at 64 nodes the node-lane burn commits a
+    bit-identical history to the per-node loop, and each mode reconciles
+    with itself (same seed twice -> same log)."""
+    kw = dict(nodes=64, concurrency=24)
+    mesh, eng, loop = _logs(3, 120, **kw)
+    assert mesh.acked == loop.acked == 120
+    assert mesh.log == loop.log
+    again, _ = run_mesh_burn(3, 120, mesh_tick=True, collect_log=True, **kw)
+    assert mesh.log == again.log, "node-lane burn is not reconcilable"
+    assert eng.snapshot()["nodes_per_dispatch"] > 2.0
+
+
+def test_compaction_pin_isolation_across_nodes():
+    """Tiny arenas force growth/compaction generations mid-burn on every
+    node. Each plan's merge inputs are the SNAPSHOT arrays pinned at
+    encode time, so one node's arena churn must not perturb another
+    node's lane: histories stay bit-identical to the per-node loop, which
+    pins the very same snapshots."""
+    rkw = dict(initial_cap=128)
+    mesh, eng, loop = _logs(17, 80, nodes=4, key_count=96,
+                            resolver_kwargs=rkw)
+    assert mesh.acked == loop.acked == 80
+    assert mesh.log == loop.log, \
+        "arena churn leaked across node lanes in the merged dispatch"
+    assert eng.snapshot()["mesh_tick_fallbacks"] == 0
+
+
+def test_crash_restart_lane_pads_out_without_recompile():
+    """A crashed node drops out of the cluster tick (its lane pads out of
+    the merge); the restarted incarnation's fresh resolver re-adopts the
+    engine via the factory. With pad_node_tiers fixing the block-count
+    tier, the shrink and regrow mint NO new node-kernel compiles after
+    the warm run -- and the history still matches the per-node loop."""
+    from accord_tpu.ops.node_lane import node_lane_cache_sizes
+
+    kw = dict(nodes=4, crash_restart=True, crash_down_ms=400.0,
+              pad_node_tiers=8)
+    warm, _ = run_mesh_burn(21, 70, mesh_tick=True, collect_log=True, **kw)
+    sizes = dict(node_lane_cache_sizes())
+    mesh, eng, loop = _logs(29, 70, **kw)
+    assert mesh.log == loop.log
+    after = node_lane_cache_sizes()
+    for k in ("node_fused_deps_resolve", "node_fused_range_deps_resolve"):
+        assert after[k] == sizes[k], \
+            f"{k} minted compiles across node-count churn: " \
+            f"{sizes[k]} -> {after[k]}"
+
+
+def test_cluster_tick_counters_fold_into_report():
+    """The engine's glossary counters ride the burn report, and the
+    padded-row accounting is internally consistent."""
+    rep, eng = run_mesh_burn(13, 40, nodes=3)
+    for k in ("node_lane_dispatches", "nodes_per_dispatch",
+              "node_pad_fraction", "mesh_tick_fallbacks"):
+        assert k in rep.counters
+    assert 0.0 <= rep.counters["node_pad_fraction"] < 1.0
+    assert rep.counters["node_lane_dispatches"] == \
+        eng.snapshot()["node_lane_dispatches"]
+
+
+def test_cli_reconcile():
+    """The module CLI's --reconcile leg: two runs of each seed, identical
+    logs, exit 0."""
+    from accord_tpu.sim import mesh_burn
+    rc = mesh_burn.main(["--seed", "1", "--ops", "40", "--nodes", "3",
+                         "--reconcile"])
+    assert rc == 0
+
+
+@pytest.mark.slow
+def test_sharded_node_tick_matches_single_device():
+    """sharded_node_tick (node-major block axis over 'data', buckets over
+    'model') commits the same history as the single-device node lane and
+    the per-node loop."""
+    rkw = dict(num_buckets=256, initial_cap=512)
+    kw = dict(nodes=4, resolver_kwargs=rkw)
+    sh, eng, shloop = _logs(5, 50, sharded=True, **kw)
+    assert sh.log == shloop.log
+    single, _ = run_mesh_burn(5, 50, mesh_tick=True, collect_log=True, **kw)
+    assert sh.log == single.log
+    assert eng.snapshot()["node_lane_dispatches"] > 0
+
+
+def test_engine_reuse_rejected_reentry_safe():
+    """note_work during a firing tick arms the NEXT tick (no lost work):
+    exercised implicitly by every burn above; here assert the engine's
+    dedupe keeps one armed event per window and the pending map clears."""
+    eng = ClusterTickEngine()
+    rep, eng2 = run_mesh_burn(31, 30, nodes=3, engine=eng)
+    assert eng2 is eng
+    assert not eng._pending, "pending work left behind at quiescence"
+    assert not eng._armed

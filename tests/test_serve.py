@@ -92,6 +92,37 @@ def test_line_decoder_partial_lines():
     assert transport.decode_json_line(b'{"b": 2}') == {"b": 2}
 
 
+def test_bind_host_parses_and_roundtrips():
+    """Multi-host plumbing, no sockets: non-loopback addresses parse, the
+    bind/advertise split lands in ServeConfig (bind_host is the bound
+    interface; `listen` stays the ADVERTISED address peers dial, and
+    None means bind the advertised host -- the loopback CI default), and
+    peer maps carrying non-loopback addresses survive the frame codec."""
+    from accord_tpu.serve.server import ServeConfig, _parse_addr, _parse_peers
+
+    assert _parse_addr("0.0.0.0:7001") == ("0.0.0.0", 7001)
+    assert _parse_addr("10.1.2.3:7102") == ("10.1.2.3", 7102)
+    assert _parse_addr("7103") == ("127.0.0.1", 7103)  # bare-port default
+
+    peers = _parse_peers("1=10.1.2.3:7101,2=10.1.2.4:7101,3=127.0.0.1:7103")
+    assert peers == {1: ("10.1.2.3", 7101), 2: ("10.1.2.4", 7101),
+                     3: ("127.0.0.1", 7103)}
+
+    cfg = ServeConfig(node_id=1, listen=("10.1.2.3", 7101), peers=peers,
+                      bind_host="0.0.0.0")
+    assert cfg.bind_host == "0.0.0.0"
+    assert cfg.listen == ("10.1.2.3", 7101)  # advertised, not the bind
+    assert ServeConfig(node_id=1, listen=("127.0.0.1", 7101),
+                       peers=peers).bind_host is None
+
+    # a peer-exchange payload with routable addresses round-trips the
+    # length-prefixed wire codec byte-exactly
+    env = {"t": "peers", "from": 1,
+           "payload": {nid: list(addr) for nid, addr in peers.items()}}
+    (raw,) = transport.FrameDecoder().feed(transport.encode_envelope(env))
+    assert transport.decode_message(raw) == env
+
+
 # -- admission ----------------------------------------------------------------
 
 def test_token_bucket_rate_and_burst():
