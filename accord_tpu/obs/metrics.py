@@ -374,6 +374,8 @@ GLOSSARY: Dict[str, str] = {
     "cmd_plane_checksum_mismatches": "cmd harvests rejected by the checksum lane",
     "cmd_plane_compactions": "cmd-arena compaction passes (generation bumps)",
     "cmd_plane_flush_s": "dirty-lane scatter upload wall seconds",
+    "cmd_deferred_spans": "PreAccept spans decided by the host twin for the fused tick",
+    "cmd_deferred_ops": "protocol ops deferred through the host twin (megakernel mode)",
     # -- per-node txn lifecycle (Node.metrics) -------------------------------
     "txn.started": "coordinations started on this node",
     "txn.failed": "coordinations failed (timeout/invalidated)",
@@ -403,4 +405,7 @@ GLOSSARY: Dict[str, str] = {
     "nodes_per_dispatch": "mean distinct nodes whose plans rode one merged dispatch",
     "node_pad_fraction": "share of merged subject rows that were node-tier padding",
     "mesh_tick_fallbacks": "plans launched per-node because no merge inputs were recorded",
+    "megakernel_dispatches": "cluster ticks launched as one fused protocol_tick program",
+    "launches_per_tick": "mean device program launches per cluster tick that dispatched",
+    "fastpath_quorum_txns": "distinct txns whose PreAccept lanes met the in-kernel fast-path quorum",
 }

@@ -196,6 +196,10 @@ class FlightRecorder:
 # read `REC.enabled` (one attribute load) before doing any work.
 REC = FlightRecorder()
 
+# Trace process id for cluster-scoped spans (the ClusterTickEngine's
+# per-tick megakernel span): node pids are NodeIds >= 1, so 0 is free.
+CLUSTER_PID = 0
+
 
 def recorder() -> FlightRecorder:
     return REC

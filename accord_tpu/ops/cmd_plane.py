@@ -63,6 +63,8 @@ from accord_tpu.ops.kernels import (CMD_F_DEPS_EMPTY, CMD_F_EPOCH_OK,  # noqa: E
                                     CMD_OP_ACCEPT, CMD_OP_APPLY,
                                     CMD_OP_COMMIT, CMD_OP_PREACCEPT,
                                     CMD_OP_TIERS, CMD_OUT_INCONSISTENT_BIT,
+                                    CMD_OUT_REDUNDANT, CMD_OUT_REJECTED_BALLOT,
+                                    CMD_OUT_SUCCESS, CMD_OUT_TRUNCATED,
                                     CMD_OUT_WAS_STABLE_BIT, CMD_ST_ACCEPTED,
                                     CMD_ST_APPLIED, CMD_ST_INVALIDATED,
                                     CMD_ST_PRE_ACCEPTED, CMD_ST_PRE_APPLIED,
@@ -187,6 +189,8 @@ class CmdPlane:
     fallbacks = RegCounter("cmd_plane_fallbacks")
     checksum_mismatches = RegCounter("cmd_plane_checksum_mismatches")
     compactions = RegCounter("cmd_plane_compactions")
+    deferred_spans = RegCounter("cmd_deferred_spans")
+    deferred_ops = RegCounter("cmd_deferred_ops")
     flush_s = RegTimer("cmd_plane_flush_s")
 
     def __init__(self, store, initial_cap: int = 1024, key_cap: int = 1024,
@@ -637,6 +641,168 @@ class CmdPlane:
             code = int(out_code[j])
             ts = (None if out_ts[j][0] == _NEG
                   else _dec(*(int(x) for x in out_ts[j])))
+            if self.apply_to_store:
+                self._residual(op, code, ts)
+            results[i] = self._result(op, code, ts, int(out_status[j]))
+
+    # -- deferred evaluation (the protocol megakernel) -----------------------
+
+    def defer_batch(self, ops: Sequence[CmdOp],
+                    sink=None) -> List[CmdResult]:
+        """eval_batch's megakernel twin: decide each admissible PreAccept
+        span with the HOST INTEGER TWIN of cmd_tick's PreAccept lane (the
+        drain needs the decisions synchronously, before the tick's single
+        fused dispatch is assembled) and hand the resulting transition
+        lanes to `sink` so they ride protocol_tick's quorum stage. Shadows
+        stay authoritative; touched rows mark dirty and the next _flush
+        repairs the device columns lazily -- no device dispatch for the
+        PreAccept spans, which is the whole point. Admission, ordering, and
+        fallback interleaving mirror eval_batch exactly: an admissible
+        non-PreAccept op flushes the pending twin span and runs as its own
+        DEVICE span (eval_batch would have put it on device, and device vs
+        host handlers differ observably for Commit/Apply), an inadmissible
+        op flushes and takes the host handler -- so histories are
+        bit-identical to the device path for any op mix."""
+        with self._lock:
+            results: List[Optional[CmdResult]] = [None] * len(ops)
+            run: List[Tuple[int, CmdOp]] = []
+            store_ok = self._store_ok()
+            for i, op in enumerate(ops):
+                adm = self._admit(op, store_ok)
+                if adm and op.kind == CMD_OP_PREACCEPT:
+                    run.append((i, op))
+                    continue
+                self._twin_run(run, results, sink)
+                run = []
+                if adm:
+                    self._run_device([(i, op)], results)
+                else:
+                    self.fallbacks += 1
+                    results[i] = self._host_one(op)
+                    store_ok = self._store_ok()
+            self._twin_run(run, results, sink)
+            return results   # type: ignore[return-value]
+
+    def _twin_run(self, run: List[Tuple[int, CmdOp]],
+                  results: List[Optional[CmdResult]], sink=None) -> None:
+        """Sequential host integer twin of cmd_tick's PreAccept lane over
+        one admissible span: same gathers, same predicates, same unique_now
+        arithmetic, same writebacks -- executed op by op against the shadow
+        columns, so intra-span chains resolve exactly like the kernel's
+        prev-writer links (tests/test_megakernel.py runs the differential
+        against eval_batch)."""
+        if not run:
+            return
+        node = self.store.node
+        ops = [op for _, op in run]
+        # _row_for/_kid_for lazily create and seed rows -- same call order
+        # as _run_device so allocation histories match bit for bit
+        rows = [self._row_for(op.txn_id) for op in ops]
+        kid_rows = [[self._kid_for(k) for k in op.owned] for op in ops]
+        now = int(node.time_service.now_micros())
+        timeout_us = node.agent.pre_accept_timeout_ms() * 1000.0
+        node_epoch = int(node.epoch)
+        lane2_clean = node.id - _LANE2_OFF
+        lane2_rej = ((0x8000 << 16) | node.id) - _LANE2_OFF
+        clock = int(node._last_hlc)
+        n = len(ops)
+        q_txn = np.zeros((n, 3), np.int32)
+        q_ts = np.full((n, 3), _NEG, np.int32)
+        q_code = np.zeros(n, np.int32)
+        out_status = np.zeros(n, np.int32)
+
+        for j, op in enumerate(ops):
+            r = rows[j]
+            txn = _enc(op.txn_id)
+            bal = _enc(op.ballot)
+            permit_fast = op.ballot == Ballot.ZERO
+            epoch_ok = op.txn_id.epoch >= node_epoch
+            expired = (not op.txn_id.kind.is_sync_point
+                       and now - op.txn_id.hlc >= timeout_us)
+            st = int(self.status_h[r])
+            fl = int(self.flags_h[r])
+            pr = tuple(int(x) for x in self.promised_h[r])
+            ea = tuple(int(x) for x in self.ea_h[r])
+            has_txn = (fl & 1) != 0
+            ea_set = ea[0] != _NEG
+            terminal = st in (CMD_ST_INVALIDATED, CMD_ST_TRUNCATED)
+            pr_gt_bal = bal < pr
+            term_code = (CMD_OUT_REJECTED_BALLOT
+                         if st == CMD_ST_INVALIDATED else CMD_OUT_TRUNCATED)
+            mc = None
+            for kid in kid_rows[j]:
+                if self.kvalid_h[kid]:
+                    v = tuple(int(x) for x in self.kmax_h[kid])
+                    if mc is None or v > mc:
+                        mc = v
+            mc_any = mc is not None
+
+            def unow(al_ep, al_hlc, lane2):
+                h = max(now, clock + 1)
+                if al_hlc >= h:
+                    h = al_hlc + 1
+                return (max(node_epoch, al_ep), h, lane2), h
+
+            rej_w, rej_h = unow(txn[0], txn[1], lane2_rej)
+            al = mc if mc_any else txn
+            slow_w, slow_h = unow(al[0], al[1], lane2_clean)
+            fast = permit_fast and epoch_ok \
+                and (not mc_any or not (txn < mc))
+            witness = rej_w if expired else (txn if fast else slow_w)
+            wit_clock = rej_h if expired else (clock if fast else slow_h)
+            blocked = terminal or pr_gt_bal
+            code = (term_code if terminal
+                    else CMD_OUT_REJECTED_BALLOT if pr_gt_bal
+                    else CMD_OUT_REDUNDANT if has_txn and permit_fast
+                    else CMD_OUT_SUCCESS)
+            pa_wit = not blocked and not has_txn and not ea_set
+            if blocked or has_txn:
+                new_st = st
+            elif ea_set:
+                new_st = max(st, CMD_ST_PRE_ACCEPTED)
+            else:
+                new_st = CMD_ST_PRE_ACCEPTED
+            new_fl = fl if blocked else (fl | 1)
+            new_pr = pr if blocked else max(pr, bal)
+            new_ea = witness if pa_wit else ea
+            if pa_wit:
+                clock = wit_clock
+
+            vals = {"status": np.int32(new_st),
+                    "flags": np.int32(new_fl),
+                    "promised": np.asarray(new_pr, np.int32),
+                    "execute_at": np.asarray(new_ea, np.int32)}
+            for name, v in vals.items():
+                sh = self._shadow_of(name)
+                if not np.array_equal(sh[r], v):
+                    sh[r] = v
+                    self._dirty[name].add(r)
+            if pa_wit:
+                w_arr = np.asarray(witness, np.int32)
+                for kid in kid_rows[j]:
+                    kv = bool(self.kvalid_h[kid])
+                    km = tuple(int(x) for x in self.kmax_h[kid])
+                    if not kv or km < witness:
+                        self.kmax_h[kid] = w_arr
+                        self._kdirty.add(kid)
+                    if not kv:
+                        self.kvalid_h[kid] = True
+                        self._kdirty.add(kid)
+
+            q_txn[j] = txn
+            q_ts[j] = new_ea
+            q_code[j] = code
+            out_status[j] = new_st
+
+        node._last_hlc = clock
+        self.deferred_spans += 1
+        self.deferred_ops += n
+        if sink is not None:
+            sink(q_txn, q_ts, q_code)
+        for (i, op), j in zip(run, range(n)):
+            code = int(q_code[j])
+            ts = (None if q_ts[j][0] == _NEG
+                  else _dec(*(int(x) for x in q_ts[j])))
             if self.apply_to_store:
                 self._residual(op, code, ts)
             results[i] = self._result(op, code, ts, int(out_status[j]))

@@ -618,6 +618,16 @@ def finalize_csr(packed, word_off, kid_rows, slot_subj, slot_kid,
        pass (resolver's OutCapTiers policy). `csum` is the csr_checksum
        integrity word over the triple, verified at harvest.
     """
+    return _finalize_csr_body(packed, word_off, kid_rows, slot_subj,
+                              slot_kid, subj_row, act_ts, out_cap)
+
+
+def _finalize_csr_body(packed, word_off, kid_rows, slot_subj, slot_kid,
+                       subj_row, act_ts, out_cap: int):
+    """finalize_csr's trace body, unjitted so protocol_tick can inline the
+    same compaction inside the fused cluster-tick program (the standalone
+    jit wrapper above delegates here -- one source of truth, bit-identical
+    either way)."""
     b = packed.shape[0]
     kc, w = kid_rows.shape
     blk = jax.lax.dynamic_slice_in_dim(packed, word_off, w, axis=1)
@@ -669,6 +679,18 @@ def range_finalize_csr(iv_of, iv_start, iv_end, ent_ok,
        bound from PR 8). `csum` is the csr_checksum integrity word,
        verified at harvest.
     """
+    return _range_finalize_csr_body(iv_of, iv_start, iv_end, ent_ok,
+                                    subj_before, subj_kinds,
+                                    r_start, r_end, r_ts, r_kinds, r_valid,
+                                    witness_table, out_cap)
+
+
+def _range_finalize_csr_body(iv_of, iv_start, iv_end, ent_ok,
+                             subj_before, subj_kinds,
+                             r_start, r_end, r_ts, r_kinds, r_valid,
+                             witness_table, out_cap: int):
+    """range_finalize_csr's trace body, unjitted for protocol_tick (see
+    _finalize_csr_body)."""
     b = subj_before.shape[0]
     o = jnp.clip(iv_of, 0, b - 1)
     inb = (iv_of >= 0) & (iv_of < b) & ent_ok
@@ -957,6 +979,24 @@ def cmd_tick(status, flags, promised, accepted, execute_at, durability,
     -> (updated columns..., out_code i32[n], out_ts i32[n, 3] (witnessed /
         echoed executeAt), out_status i32[n], csum u32)
     """
+    return _cmd_tick_body(status, flags, promised, accepted, execute_at,
+                          durability, kmax, kmax_valid, clock,
+                          op_kind, op_row, op_txn, op_ballot, op_exec,
+                          op_keys, op_flags, op_now, op_prev, op_rlast,
+                          op_kprev, op_klast, node_epoch, lane2_clean,
+                          lane2_rej, dur_local, promote)
+
+
+def _cmd_tick_body(status, flags, promised, accepted, execute_at, durability,
+                   kmax, kmax_valid, clock,
+                   op_kind, op_row, op_txn, op_ballot, op_exec, op_keys,
+                   op_flags, op_now, op_prev, op_rlast, op_kprev, op_klast,
+                   node_epoch, lane2_clean, lane2_rej,
+                   dur_local, promote: bool = False):
+    """cmd_tick's trace body, unjitted so protocol_tick can run the same
+    batched transitions inside the fused cluster-tick program. Keeps the
+    op-tier-sized fori_loop carry (see the docstring above: a cap-sized
+    carry makes XLA duplicate the columns every iteration)."""
     cap = status.shape[0]
     kcap = kmax.shape[0]
     n, kpad = op_keys.shape
@@ -1175,6 +1215,146 @@ def cmd_tick(status, flags, promised, accepted, execute_at, durability,
             cmd_checksum(out_code, out_status, out_ts, clock))
 
 
+# -- the protocol megakernel --------------------------------------------------
+#
+# One jitted program per cluster tick: the node-lane resolve (key + range),
+# every plan's finalize-CSR compaction demuxed IN-KERNEL at its merge span,
+# optional cmd_tick blocks, and the fast-path electorate-quorum count over
+# the tick's PreAccept lanes. Each stage is the SAME trace body the
+# standalone kernels run (_finalize_csr_body / _range_finalize_csr_body /
+# _cmd_tick_body and the node_lane resolve bodies), so fused outputs are
+# bit-identical to the unfused ≤2-dispatch path by construction.
+#
+# Programs are cached per static signature (which stages are present, each
+# finalize's slice shape + out_cap, each cmd block's promote flag, the
+# quorum size); every shape in the signature rides an existing tier ladder,
+# so warm burns re-land on compiled entries.
+
+_PROTOCOL_TICK_FNS: dict = {}
+
+
+def _protocol_tick_fn(statics):
+    fn = _PROTOCOL_TICK_FNS.get(statics)
+    if fn is not None:
+        return fn
+    has_key, has_rng, fin_statics, cmd_promotes, qsize = statics
+    # node_lane imports from this module -- resolve lazily (first call
+    # always happens after the engine imported it)
+    from accord_tpu.ops import node_lane as _nl
+
+    def run(witness_table, key_in, rng_in, fin_in, cmd_in, q_in):
+        packed = ()
+        rng_out = ()
+        if has_key:
+            packed = _nl._key_resolve_body(*key_in, witness_table)
+        if has_rng:
+            rng_out = _nl._range_resolve_body(*rng_in, witness_table)
+        fin_outs = []
+        for spec, args in zip(fin_statics, fin_in):
+            kind = spec[0]
+            if kind == "range":
+                (iv_of, iv_s, iv_e, ent_ok, f_sb, f_sknd,
+                 (r_start, r_end, r_ts, r_kinds, r_valid)) = args
+                fin_outs.append(_range_finalize_csr_body(
+                    iv_of, iv_s, iv_e, ent_ok, f_sb, f_sknd,
+                    r_start, r_end, r_ts, r_kinds, r_valid,
+                    witness_table, spec[1]))
+            else:
+                _k, rows, words, out_cap = spec
+                (r0, w_lo, word_off, kid_rows, slot_subj, slot_kid,
+                 subj_row, act_ts) = args
+                src = packed if kind == "key" else rng_out[1]
+                blk = jax.lax.dynamic_slice(src, (r0, w_lo), (rows, words))
+                fin_outs.append(_finalize_csr_body(
+                    blk, word_off, kid_rows, slot_subj, slot_kid,
+                    subj_row, act_ts, out_cap))
+        cmd_outs = []
+        for promote, args in zip(cmd_promotes, cmd_in):
+            cmd_outs.append(_cmd_tick_body(*args, promote=promote))
+        q_out = ()
+        if qsize is not None:
+            q_txn, q_ts, q_code, q_valid = q_in
+            # a lane is a fast-path PreAccept witness iff it SUCCEEDED and
+            # echoed the txn id unchanged (the host fastpath test)
+            fast = q_valid & ((q_code & 7) == CMD_OUT_SUCCESS) \
+                & jnp.all(q_ts == q_txn, axis=1)
+            same = jnp.all(q_txn[:, None, :] == q_txn[None, :, :], axis=2)
+            votes = jnp.sum(same & fast[None, :], axis=1, dtype=jnp.int32)
+            q_out = (fast, votes, fast & (votes >= qsize))
+        return packed, rng_out, tuple(fin_outs), tuple(cmd_outs), q_out
+
+    fn = jax.jit(run)
+    _PROTOCOL_TICK_FNS[statics] = fn
+    return fn
+
+
+def protocol_tick(witness_table, key_in=None, rng_in=None, fins=(),
+                  cmds=(), quorum=None, quorum_size=1):
+    """Launch the fused cluster-tick program: ONE device dispatch covering
+    deps resolve, finalize compaction, cmd transitions, and the fast-path
+    quorum count.
+
+    key_in:  node_fused_deps_resolve's args minus witness_table, or None
+    rng_in:  node_fused_range_deps_resolve's args minus witness_table
+    fins:    finalize specs, one per (plan, group), in harvest order:
+               ("key",  row_off, w_lo, rows, words, word_off, kid_rows,
+                slot_subj, slot_kid, subj_row, act_ts, out_cap)
+               ("rkey", ... same lanes, sliced from the k-side range output)
+               ("range", iv_of, iv_s, iv_e, ent_ok, sb, sknd,
+                rsnap 5-tuple, out_cap)
+             key/rkey specs dynamic-slice their plan's [rows x words] span
+             out of the merged packed result in-kernel, then run the exact
+             finalize_csr body with the group's word offset -- slot_subj is
+             plan-local, so recorded finalize lanes work unchanged.
+    cmds:    cmd_tick arg tuples (every positional arg, promote last); the
+             promote flag is static, everything else traced.
+    quorum:  (txn i32[t,3], ts i32[t,3], code i32[t], valid bool[t]) lanes
+             from the tick's PreAccept spans, padded to a MEGA_LANE_TIERS
+             tier; quorum_size the electorate majority (static).
+    -> (packed, (rpacked, kpacked), fin_outs, cmd_outs,
+        (fast, votes, met)); absent stages return ().
+    """
+    fin_statics, fin_traced = [], []
+    for f in fins:
+        if f[0] == "range":
+            fin_statics.append(("range", f[8]))
+            fin_traced.append(tuple(f[1:8]))
+        else:
+            fin_statics.append((f[0], f[3], f[4], f[11]))
+            fin_traced.append((f[1], f[2]) + tuple(f[5:11]))
+    # canonicalize: stable-sort the finalize specs by static signature so
+    # the compiled-program key depends on the tick's signature MULTISET,
+    # not the arrival order of plans -- order jitter across ticks would
+    # otherwise mint a fresh multi-second compile per permutation
+    order = sorted(range(len(fin_statics)), key=lambda i: fin_statics[i])
+    fin_statics = [fin_statics[i] for i in order]
+    fin_traced = [fin_traced[i] for i in order]
+    cmd_statics = tuple(bool(c[-1]) for c in cmds)
+    cmd_traced = tuple(tuple(c[:-1]) for c in cmds)
+    statics = (key_in is not None, rng_in is not None, tuple(fin_statics),
+               cmd_statics, int(quorum_size) if quorum is not None else None)
+    fn = _protocol_tick_fn(statics)
+    packed, rng_out, fin_outs, cmd_outs, q_out = fn(
+        witness_table,
+        tuple(key_in) if key_in is not None else (),
+        tuple(rng_in) if rng_in is not None else (),
+        tuple(fin_traced), cmd_traced,
+        tuple(quorum) if quorum is not None else ())
+    if order != list(range(len(order))):
+        # undo the canonical sort: callers demux fin_outs positionally
+        back = [0] * len(order)
+        for pos, i in enumerate(order):
+            back[i] = pos
+        fin_outs = tuple(fin_outs[back[i]] for i in range(len(order)))
+    return packed, rng_out, fin_outs, cmd_outs, q_out
+
+
+def protocol_tick_cache_sizes() -> int:
+    """Total compiled protocol_tick variants across every static signature
+    (the megakernel's entry in jit_cache_sizes)."""
+    return sum(f._cache_size() for f in _PROTOCOL_TICK_FNS.values())
+
+
 def jit_cache_sizes() -> dict:
     """Compiled-variant counts of the warmable hot-path kernels: the bench
     snapshots this around its timed windows to assert warmup() covered every
@@ -1193,6 +1373,7 @@ def jit_cache_sizes() -> dict:
         "kid_word_scatter": kid_word_scatter._cache_size(),
         "fused_execution_frontier": fused_execution_frontier._cache_size(),
         "cmd_tick": cmd_tick._cache_size(),
+        "protocol_tick": protocol_tick_cache_sizes(),
         # node-lane (cluster-on-mesh burn) kernels live in ops/node_lane,
         # which imports from this module -- resolve lazily to avoid a cycle
         **_node_lane_cache_sizes(),

@@ -96,6 +96,14 @@ def node_fused_deps_resolve(subj_of, subj_keys, subj_node, subj_before,
     -> u32[B, sum(cap_s)/32] packed dependency bitmask, blocks in tuple
        order (each plan's word span is contiguous)
     """
+    return _key_resolve_body(subj_of, subj_keys, subj_node, subj_before,
+                             subj_kinds, slots, arenas, witness_table)
+
+
+def _key_resolve_body(subj_of, subj_keys, subj_node, subj_before,
+                      subj_kinds, slots, arenas, witness_table):
+    """node_fused_deps_resolve's trace body, unjitted so the protocol
+    megakernel (kernels.protocol_tick) inlines the same resolve."""
     b = subj_before.shape[0]
     k = arenas[0][0].shape[1]
     subj_bm = jnp.zeros((b, k), jnp.float32) \
@@ -127,6 +135,17 @@ def node_fused_range_deps_resolve(iv_of, iv_start, iv_end, subj_node,
 
     -> (u32[B, sum(rcap_s)/32], u32[B, sum(cap_s)/32])
     """
+    return _range_resolve_body(iv_of, iv_start, iv_end, subj_node,
+                               subj_before, subj_kinds, subj_is_range,
+                               r_slots, rarenas, k_slots, karenas,
+                               witness_table)
+
+
+def _range_resolve_body(iv_of, iv_start, iv_end, subj_node,
+                        subj_before, subj_kinds, subj_is_range,
+                        r_slots, rarenas, k_slots, karenas, witness_table):
+    """node_fused_range_deps_resolve's trace body, unjitted for
+    kernels.protocol_tick (see _key_resolve_body)."""
     b = subj_before.shape[0]
     routs = []
     for s, (r_start, r_end, r_ts, r_kinds, r_valid) in enumerate(rarenas):
@@ -164,8 +183,11 @@ def node_fused_range_deps_resolve(iv_of, iv_start, iv_end, subj_node,
 def lane_slice(packed, row_off, word_off, rows: int, words: int):
     """Demux one plan's span out of the merged packed result. Offsets are
     traced (plan position in the merge never recompiles); the slice shape
-    is static per (plan row tier, plan word width) -- the same bounded
-    ladders the per-plan kernels compile."""
+    is static per (plan row tier, plan word width). Both axes ride bounded
+    ladders: rows are per-plan subject tiers and multi-block span WIDTHS
+    pad to the node-block tier times the block word width (see
+    build_key_merge), so lane_slice sits under the same strict
+    zero-recompile gates as every other tick kernel."""
     return jax.lax.dynamic_slice(packed, (row_off, word_off), (rows, words))
 
 
@@ -179,6 +201,77 @@ def node_lane_cache_sizes() -> dict:
             node_fused_range_deps_resolve._cache_size(),
         "lane_slice": lane_slice._cache_size(),
     }
+
+
+class MergedBuffer:
+    """One merged device result shared by every plan's MergedView: a single
+    async copy, a single host materialization, views slice it host-side.
+    This is the megakernel's harvest half -- the readback is ONE contiguous
+    transfer and the per-plan demux costs zero device dispatches."""
+
+    __slots__ = ("dev", "_copied", "_host")
+
+    def __init__(self, dev):
+        self.dev = dev
+        self._copied = False
+        self._host = None
+
+    def copy_async(self) -> None:
+        if not self._copied:
+            self._copied = True
+            try:
+                self.dev.copy_to_host_async()
+            except AttributeError:
+                pass
+
+    def is_ready(self) -> bool:
+        try:
+            return self.dev.is_ready()
+        except AttributeError:
+            return True
+
+    def host(self):
+        if self._host is None:
+            self._host = np.asarray(self.dev)
+        return self._host
+
+
+class MergedView:
+    """A plan's [row_off:+rows, word_off:+words] window of a MergedBuffer,
+    duck-typed to the resolver's device-value protocol (_dev_ready /
+    _dev_copy_async / _dev_read / block_until_ready). np.asarray returns a
+    COPY of the window: the fault plane may bit-flip one plan's fetched
+    arrays (ops/fault_plane.py corrupt draws) and sibling plans sharing the
+    merged buffer must never see it."""
+
+    __slots__ = ("buf", "r0", "rows", "w0", "words")
+
+    def __init__(self, buf: MergedBuffer, r0: int, rows: int,
+                 w0: int, words: int):
+        self.buf = buf
+        self.r0 = r0
+        self.rows = rows
+        self.w0 = w0
+        self.words = words
+
+    @property
+    def shape(self):
+        return (self.rows, self.words)
+
+    def is_ready(self) -> bool:
+        return self.buf.is_ready()
+
+    def copy_to_host_async(self) -> None:
+        self.buf.copy_async()
+
+    def block_until_ready(self):
+        self.buf.host()
+        return self
+
+    def __array__(self, dtype=None):
+        h = self.buf.host()[self.r0:self.r0 + self.rows,
+                            self.w0:self.w0 + self.words]
+        return np.array(h, dtype=dtype, copy=True)
 
 
 class KeyMerge:
@@ -257,10 +350,11 @@ def build_key_merge(entries, pad_block, node_tiers=None) -> KeyMerge:
     pad_store_tiers: the baseline `_pad_fused` tops each FUSED call's block
     list up to it at launch time, so each fused plan's packed buffer
     carries those pad word columns. The merge replicates that padding
-    INSIDE the plan's span -- the demuxed slice then equals the baseline
-    buffer bit for bit, width included, and the per-group finalize kernels
-    (whose compiled shape keys on the full packed width) see exactly the
-    shapes the baseline warms."""
+    INSIDE the plan's span -- the demuxed slice's live word columns equal
+    the baseline buffer bit for bit (a multi-block span may then widen to
+    its node-block-tier word width with further all-zero columns, which the
+    group-span decode never reads -- that's what pins lane_slice's compiled
+    shapes to a bounded ladder)."""
     arg_list = [args for _, args in entries]
     offs, widths, used, b_total = _layout(arg_list)
     sb = np.zeros((b_total, 3), np.int32)
@@ -289,14 +383,18 @@ def build_key_merge(entries, pad_block, node_tiers=None) -> KeyMerge:
         live_keys.append(args["subj_keys"][mask])
         w_lo = w_off
         nreal = 0
+        nspan = 0
         cap_plan = 0
+        caps = set()
         for gslot, snap_ in zip(args["slots"], args["ksnaps"]):
             bm, ts, _ex, kinds, valid = snap_
             blocks.append((bm, ts, kinds, valid))
             slots_all.append(base + int(gslot))
             w_off += bm.shape[0] // 32
             nreal += 1
+            caps.add(bm.shape[0])
             cap_plan = max(cap_plan, bm.shape[0])
+        nspan = nreal
         tier_p = args["pad_tier"] if args["fused"] else None
         if tier_p and nreal < tier_p:
             pad = pad_block(cap_plan)
@@ -304,6 +402,22 @@ def build_key_merge(entries, pad_block, node_tiers=None) -> KeyMerge:
                 blocks.append(pad)
                 slots_all.append(-1)
                 w_off += cap_plan // 32
+            nspan = tier_p
+        # demux-span WIDTH tier (the lane_slice zero-recompile fix): pad a
+        # multi-block uniform-cap span out to the node-block tier's word
+        # width with empty blocks, so harvest slice shapes land on the
+        # (subject tier x block tier) ladder instead of minting one shape
+        # per participating store count. Single-block spans are already
+        # tiered by the arena cap ladder; mixed-cap spans (arenas caught
+        # mid-growth) keep their exact width.
+        if nspan > 1 and len(caps) == 1 and cap_plan:
+            bw = cap_plan // 32
+            want = node_block_tier(nspan, node_tiers) * bw
+            pad = pad_block(cap_plan)
+            while w_off - w_lo < want:
+                blocks.append(pad)
+                slots_all.append(-1)
+                w_off += bw
         spans.append((r0, b, w_lo, w_off - w_lo))
         base += ngroups + 1
     # block-count tier: cached empty blocks under slot -1 (no subject's
@@ -368,21 +482,35 @@ def build_range_merge(entries, pad_key_block, pad_range_block,
         tier_p = args["pad_tier"] if args["fused"] else None
         nreal_r = 0
         rcap_plan = 0
+        rcaps = set()
         if args["has_r"]:
             for gslot, snap_ in zip(args["r_slots"], args["rsnaps"]):
                 r_blocks.append(snap_)
                 r_slots.append(base + int(gslot))
                 rw_off += snap_[0].shape[0] // 32
                 nreal_r += 1
+                rcaps.add(snap_[0].shape[0])
                 rcap_plan = max(rcap_plan, snap_[0].shape[0])
+            nspan_r = nreal_r
             if tier_p and nreal_r < tier_p:
                 pad = pad_range_block(rcap_plan)
                 for _ in range(tier_p - nreal_r):
                     r_blocks.append(pad)
                     r_slots.append(-1)
                     rw_off += rcap_plan // 32
+                nspan_r = tier_p
+            # span-width tier, exactly as build_key_merge
+            if nspan_r > 1 and len(rcaps) == 1 and rcap_plan:
+                bw = rcap_plan // 32
+                want = node_block_tier(nspan_r, node_tiers) * bw
+                pad = pad_range_block(rcap_plan)
+                while rw_off - rw_lo < want:
+                    r_blocks.append(pad)
+                    r_slots.append(-1)
+                    rw_off += bw
         nreal_k = 0
         kcap_plan = 0
+        kcaps = set()
         if args["has_k"]:
             for gslot, snap_ in zip(args["k_slots"], args["ksnaps"]):
                 bm, ts, _ex, kinds, valid = snap_
@@ -390,13 +518,24 @@ def build_range_merge(entries, pad_key_block, pad_range_block,
                 k_slots.append(base + int(gslot))
                 kw_off += bm.shape[0] // 32
                 nreal_k += 1
+                kcaps.add(bm.shape[0])
                 kcap_plan = max(kcap_plan, bm.shape[0])
+            nspan_k = nreal_k
             if tier_p and nreal_k < tier_p:
                 pad = pad_key_block(kcap_plan)
                 for _ in range(tier_p - nreal_k):
                     k_blocks.append(pad)
                     k_slots.append(-1)
                     kw_off += kcap_plan // 32
+                nspan_k = tier_p
+            if nspan_k > 1 and len(kcaps) == 1 and kcap_plan:
+                bw = kcap_plan // 32
+                want = node_block_tier(nspan_k, node_tiers) * bw
+                pad = pad_key_block(kcap_plan)
+                while kw_off - kw_lo < want:
+                    k_blocks.append(pad)
+                    k_slots.append(-1)
+                    kw_off += bw
         spans.append((r0, b, rw_lo, rw_off - rw_lo, kw_lo, kw_off - kw_lo))
         base += ngroups + 1
     rtier = node_block_tier(len(r_blocks), node_tiers) if r_blocks else 0

@@ -132,7 +132,8 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
            kid_cap: int = 4096, cmd_caps=(), cmd_key_caps=(1024,),
            cmd_kpad: int = 4, cmd_op_tiers=None,
            cmd_promote_modes=(False,),
-           node_tiers=(), node_batch_tiers=None) -> None:
+           node_tiers=(), node_batch_tiers=None,
+           mega_quorum_sizes=(), mega_lane_tiers=None) -> None:
     """Pre-compile the jit shape tiers the async pipeline uses (first
     compilation costs seconds on a tunnelled TPU; production would do the
     same at process start). The jit cache is process-global, so one call
@@ -167,9 +168,15 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
     built at `pad_node_tiers` matching, node-count churn (crashes,
     membership change) then pads to pre-compiled shapes and causes zero
     steady-state recompiles. `node_batch_tiers` overrides the merged-row
-    ladder (default: the first NODE_SUBJECT_TIERS rungs); the tiny span
-    demux (`lane_slice`) compiles per span shape on first use and is
-    excluded from strict recompile gates."""
+    ladder (default: the first NODE_SUBJECT_TIERS rungs); the span demux
+    (`lane_slice`) pads its word width to the node-block tiers
+    (node_lane.build_key_merge), so it sits under the same strict
+    zero-recompile gates as every other tick kernel. `mega_quorum_sizes`
+    (opt-in) warms the protocol megakernel's quorum-only variants
+    (kernels.protocol_tick) across `mega_lane_tiers` (default: the first
+    MEGA_LANE_TIERS rungs) for each electorate majority in use; the full
+    fused programs key on per-tick finalize signatures and warm on the
+    bench's dedicated warm pass instead."""
     import jax.numpy as jnp
     from accord_tpu.ops.kernels import (NNZ_TIERS, SCATTER_NNZ_TIERS,
                                         arena_scatter, arena_scatter_keys,
@@ -311,6 +318,20 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
                     out = node_fused_range_deps_resolve(
                         of, zz, zz, snode, sb, sknd, srng, slots, rarenas,
                         slots, arenas, table)
+    if mega_quorum_sizes:
+        from accord_tpu.ops.kernels import protocol_tick
+        from accord_tpu.ops.tiers import MEGA_LANE_TIERS
+        lt = (tuple(mega_lane_tiers) if mega_lane_tiers is not None
+              else MEGA_LANE_TIERS[:2])
+        for qs in mega_quorum_sizes:
+            for t in lt:
+                out = protocol_tick(
+                    table,
+                    quorum=(jnp.zeros((t, 3), jnp.int32),
+                            jnp.zeros((t, 3), jnp.int32),
+                            jnp.zeros(t, jnp.int32),
+                            jnp.zeros(t, bool)),
+                    quorum_size=qs)[4][2]
     if out is not None:
         import jax
         jax.block_until_ready(out)
@@ -1514,7 +1535,8 @@ class _Plan:
 
     __slots__ = ("items", "groups", "key_call", "range_call", "empty",
                  "fin_calls", "rfin_calls", "kfin_calls", "want",
-                 "key_args", "range_args")
+                 "key_args", "range_args",
+                 "fin_args", "rfin_args", "kfin_args")
 
     def __init__(self, items: List[_Item], groups: List[_Group],
                  empty: bool = False):
@@ -1537,6 +1559,13 @@ class _Plan:
         self.rfin_calls: List[tuple] = []   # [(group, () -> result)]
         # range-subject key-arena stab lane: consumes the kpacked result
         self.kfin_calls: List[tuple] = []   # [(group, kpacked -> result)]
+        # raw finalize lanes per deferred call above (index-aligned with
+        # fin_calls/rfin_calls/kfin_calls), recorded only under a cluster
+        # tick_driver: the megakernel folds them into the fused
+        # protocol_tick program and swaps the closures for its outputs
+        self.fin_args: List[tuple] = []
+        self.rfin_args: List[tuple] = []
+        self.kfin_args: List[tuple] = []
         # which raw candidate buffers the harvest should read back
         self.want = (True, True, True)
 
@@ -2144,11 +2173,22 @@ class BatchDepsResolver(DepsResolver):
             while j < len(pa) and pa[j][0] is store:
                 j += 1
             batch = pa[i:j]
+            td = self.tick_driver
             try:
                 from accord_tpu.ops.cmd_plane import CmdOp
-                res = plane.eval_batch([
-                    CmdOp.preaccept(t, p, route, ballot)
-                    for (_s, t, p, route, ballot, _o) in batch])
+                cmd_ops = [CmdOp.preaccept(t, p, route, ballot)
+                           for (_s, t, p, route, ballot, _o) in batch]
+                if td is not None and getattr(td, "cmd_defer", False):
+                    # megakernel mode: decide the span with the host twin
+                    # now and ride the device transition lanes into the
+                    # tick's single fused dispatch (the quorum stage)
+                    res = plane.defer_batch(cmd_ops,
+                                            sink=td.note_cmd_lanes)
+                else:
+                    d0 = int(plane.dispatches)
+                    res = plane.eval_batch(cmd_ops)
+                    if td is not None:
+                        td.note_cmd_dispatches(int(plane.dispatches) - d0)
             except BaseException:  # noqa: BLE001
                 for entry in batch:
                     _host_one(*entry)
@@ -2549,6 +2589,14 @@ class BatchDepsResolver(DepsResolver):
                                self._run_finalize_kernel(
                                    packed, j_off, kid_rows, j_subj, j_kid,
                                    j_srow, act_ts, out_cap=oc)))
+        if self.tick_driver is not None:
+            # megakernel lane (index-aligned with the closure above):
+            # slot_subj is plan-local and g.pk the plan-local word offset,
+            # so the recorded lanes run unchanged against protocol_tick's
+            # in-kernel demux of this plan's merge span
+            plan.fin_args.append((g, ("key", kid_rows, j_subj, j_kid,
+                                      j_srow, act_ts, int(g.pk[0]),
+                                      out_cap)))
 
     def _plan_range_finalize(self, plan: _Plan, groups: List[_Group],
                              grents, givs, nv: int, j_iv, j_sb,
@@ -2596,6 +2644,9 @@ class BatchDepsResolver(DepsResolver):
                                         j_iv[0], j_iv[1], j_iv[2], j_ok,
                                         j_sb, j_sknd, *rsnap, self._table,
                                         out_cap=oc)))
+            if self.tick_driver is not None:
+                plan.rfin_args.append((g, (j_iv[0], j_iv[1], j_iv[2], j_ok,
+                                           j_sb, j_sknd, rsnap, out_cap)))
 
     def _plan_rkey_finalize(self, plan: _Plan, g: _Group, rsubs,
                             b: int) -> None:
@@ -2663,6 +2714,10 @@ class BatchDepsResolver(DepsResolver):
                                 self._run_finalize_kernel(
                                     kpacked, j_off, kid_rows, j_subj, j_kid,
                                     j_srow, act_ts, out_cap=oc)))
+        if self.tick_driver is not None:
+            plan.kfin_args.append((g, ("rkey", kid_rows, j_subj, j_kid,
+                                       j_srow, act_ts, int(g.kp[0]),
+                                       out_cap)))
 
     def _run_kernel(self, ksnap, subj_of, subj_keys, sb, sknd):
         """The single-store kernel call against a plan-time arena snapshot

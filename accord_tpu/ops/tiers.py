@@ -53,6 +53,18 @@ def snap(n: int, tiers: Tuple[int, ...], floor: int) -> int:
     return size
 
 
+# PreAccept quorum-lane ladder for the protocol megakernel
+# (kernels.protocol_tick): one cluster tick's deferred cmd-plane spans stack
+# into a single lane block for the fast-path electorate count, padded here
+# so lane-count churn between ticks re-lands on compiled signatures.
+MEGA_LANE_TIERS = (64, 256, 1024)
+
+
+def mega_lane_tier(n: int) -> int:
+    """Padded PreAccept quorum-lane count for one megakernel cluster tick."""
+    return snap(n, MEGA_LANE_TIERS, 4096)
+
+
 class OutCapTiers:
     """Hysteresis-pinned out_cap tier picker for the finalize kernels.
 

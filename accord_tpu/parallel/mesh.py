@@ -29,6 +29,11 @@ shards partition words contiguously, so the merged CSR is bit-identical
 to the single-device kernel's. The interval-stab finalize
 (range_finalize_csr) stays a plain jit: the range arena is tiny (tens of
 rows) and carries no word-packed matrix worth sharding.
+
+The protocol megakernel (ops/kernels.protocol_tick) is single-device by
+design: sharded clusters keep this module's unfused <=2-dispatch pair
+(sharded_node_tick) -- fusing finalize + quorum stages into the shard_map
+program is the open scale-out follow-up (see ROADMAP).
 """
 from __future__ import annotations
 

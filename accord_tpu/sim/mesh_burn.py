@@ -23,26 +23,43 @@ calls by construction (exact 0/1 bf16 integer products, per-block slot
 masks, 32-aligned word spans, baseline `_pad_fused` padding replicated
 inside each plan's span -- see ops/node_lane.py).
 
-Scope note: the merged dispatch covers the deps-resolve kernels (the
-per-tick dispatch that scales with node count). Finalize-CSR compaction
-launches ride the same host event per plan group against the merged
-result's demuxed spans, and cmd_tick spans keep firing synchronously inside
-each node's drain -- folding those two into the same device call is the
-remaining ROADMAP item 1/2 carry-over.
+The protocol megakernel (megakernel=True, single device): the whole tick
+collapses further, into ONE fused device program (ops/kernels.protocol_tick)
+-- key+range node-lane resolve, every merged plan's finalize-CSR compaction
+demuxed IN-KERNEL at its merge span (checksum word included), and the
+fast-path electorate-quorum count over the tick's PreAccept lanes. The
+cmd-plane spans that used to dispatch synchronously inside each node's
+drain instead decide on the HOST INTEGER TWIN (cmd_plane.defer_batch) --
+the drain needs decisions before the dispatch is assembled -- and their
+transition lanes ride the same program's quorum stage. Harvest demux is
+pure host slicing of the one contiguous readback (node_lane.MergedView),
+so post-warmup a cluster tick costs exactly one device program launch
+(`launches_per_tick`). mesh_tick=False (the per-node loop) and
+megakernel=False (the unfused <=2-dispatch merge) stay live as
+bit-identical differential baselines under --reconcile. The sharded path
+(parallel/mesh.sharded_node_tick) keeps the unfused dispatch pair.
 
 CLI:  python -m accord_tpu.sim.mesh_burn --seed 1 --ops 500 --nodes 8
       [--python-loop]  per-node launch loop (the differential baseline)
+      [--megakernel]   one fused protocol_tick program per cluster tick
       [--reconcile]    run each seed twice; require identical event logs
 """
 from __future__ import annotations
 
 import argparse
 import json
+import logging
 import sys
+import time as _time
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
+from accord_tpu.obs.trace import CLUSTER_PID, REC, node_ts
 from accord_tpu.sim.burn import BurnReport, run_burn
 from accord_tpu.sim.cluster import ClusterConfig
+
+logger = logging.getLogger(__name__)
 
 
 class ClusterTickEngine:
@@ -58,8 +75,16 @@ class ClusterTickEngine:
     nodes are skipped at fire time via their scheduler's alive cell, which
     is exactly the baseline's NodeScheduler-guard semantics."""
 
-    def __init__(self, mesh_tick: bool = True):
+    def __init__(self, mesh_tick: bool = True, megakernel: bool = False):
         self.mesh_tick = mesh_tick
+        # megakernel rides the mesh_tick staging (it consumes the same
+        # recorded plan args); cmd spans defer to the host twin so their
+        # transition lanes can join the fused program's quorum stage
+        self.megakernel = megakernel and mesh_tick
+        self.cmd_defer = self.megakernel
+        # fast-path electorate majority for the in-kernel quorum count
+        # (run_mesh_burn sets it from rf)
+        self.quorum_size = 1
         self._pending: Dict[tuple, tuple] = {}
         self._armed = False
         self._queue = None
@@ -68,14 +93,29 @@ class ClusterTickEngine:
         self.cluster_ticks = 0
         self.node_lane_dispatches = 0
         self.mesh_tick_fallbacks = 0
+        self.megakernel_dispatches = 0
+        self.fastpath_quorum_txns = 0
         # per-plan deferred kernel calls staged this run -- in loop mode
         # each is one device dispatch; in mesh mode they collapse into
         # node_lane_dispatches (bench reads this attribute directly; it
-        # is not a glossary counter)
+        # is not a glossary counter). Includes cmd_tick spans fired
+        # synchronously inside each node's drain (note_cmd_dispatches).
         self.plan_kernel_launches = 0
+        # device program launches attributable to the tick path (merged
+        # dispatches, per-plan demux slices, finalize launches, cmd spans):
+        # the numerator of launches_per_tick. In megakernel mode every
+        # fused tick contributes exactly 1.
+        self.protocol_launches = 0
+        self._ticks_with_dispatch = 0
         self._nodes_in_dispatches = 0
         self._rows_used = 0
         self._rows_total = 0
+        # deferred cmd-plane transition lanes awaiting the next fused tick
+        # (note_cmd_lanes), and fused quorum outputs awaiting their lazy
+        # host readback (drained at the next fire/snapshot)
+        self._cmd_lanes: List[tuple] = []
+        self._pending_quorum: List[tuple] = []
+        self._warned_cfgs: set = set()
 
     def adopt(self, resolver):
         """Attach this engine as the resolver's tick driver (wrap the
@@ -85,7 +125,9 @@ class ClusterTickEngine:
         return resolver
 
     def snapshot(self) -> Dict[str, float]:
+        self._drain_quorum()
         n = self.node_lane_dispatches
+        t = self._ticks_with_dispatch
         return {
             "cluster_ticks": self.cluster_ticks,
             "node_lane_dispatches": n,
@@ -94,7 +136,33 @@ class ClusterTickEngine:
                 (self._rows_total - self._rows_used) / self._rows_total
                 if self._rows_total else 0.0),
             "mesh_tick_fallbacks": self.mesh_tick_fallbacks,
+            "megakernel_dispatches": self.megakernel_dispatches,
+            "launches_per_tick": (self.protocol_launches / t) if t else 0.0,
+            "fastpath_quorum_txns": self.fastpath_quorum_txns,
         }
+
+    # -- cmd-plane hooks (resolver._drain_and_preaccept) -------------------
+    def note_cmd_dispatches(self, n: int) -> None:
+        """A drain's synchronous cmd_tick spans fired n device dispatches
+        (non-deferred mode): they belong to this tick's launch count."""
+        self.plan_kernel_launches += n
+        self.protocol_launches += n
+
+    def note_cmd_lanes(self, q_txn, q_ts, q_code) -> None:
+        """A deferred cmd-plane span's transition lanes (host-twin
+        decided): stacked into the next fused tick's quorum stage."""
+        self._cmd_lanes.append((q_txn, q_ts, q_code))
+
+    def _drain_quorum(self) -> None:
+        """Count fast-path quorum txns from completed fused ticks: the
+        device `met` lane is read back lazily (here, a tick later or at
+        snapshot), never on the tick's critical path."""
+        for met_dev, q_txn in self._pending_quorum:
+            met = np.asarray(met_dev)
+            hit = {tuple(int(x) for x in q_txn[i])
+                   for i in np.nonzero(met[:len(q_txn)])[0]}
+            self.fastpath_quorum_txns += len(hit)
+        self._pending_quorum = []
 
     # -- resolver hook ----------------------------------------------------
     def note_work(self, resolver, node, window_ms: float) -> None:
@@ -112,11 +180,17 @@ class ClusterTickEngine:
     # -- the cluster tick -------------------------------------------------
     def _fire(self) -> None:
         self._armed = False
+        self._drain_quorum()
         pend = sorted(self._pending.values(), key=lambda rn: rn[1].id)
         self._pending = {}
         if not pend:
             return
         self.cluster_ticks += 1
+        # launches attributed to this tick = the delta over the whole fire
+        # (drains fire synchronous cmd spans before staging completes)
+        l0 = self.protocol_launches
+        t0 = _time.perf_counter()
+        rec_ts = node_ts(pend[0][1]) if REC.enabled else 0
         staged: List[tuple] = []
         for res, node in pend:
             if not node.scheduler.alive[0]:
@@ -129,19 +203,31 @@ class ClusterTickEngine:
             plans = [res._stage(node, sub) for sub in res._slices(items)]
             if plans:
                 staged.append((res, node, plans))
-        if not staged:
-            return
-        for _res, _node, plans in staged:
-            for plan in plans:
-                self.plan_kernel_launches += (
-                    (plan.key_call is not None)
-                    + (plan.range_call is not None))
-        if self.mesh_tick:
-            self._merged_launch(staged)
-        else:
-            for res, node, plans in staged:
+        if staged:
+            for _res, _node, plans in staged:
                 for plan in plans:
-                    res._launch(node, plan)
+                    self.plan_kernel_launches += (
+                        (plan.key_call is not None)
+                        + (plan.range_call is not None))
+            if self.mesh_tick:
+                self._merged_launch(staged)
+            else:
+                for res, node, plans in staged:
+                    for plan in plans:
+                        self.protocol_launches += (
+                            (plan.key_call is not None)
+                            + (plan.range_call is not None)
+                            + len(plan.fin_calls) + len(plan.rfin_calls)
+                            + len(plan.kfin_calls))
+                        res._launch(node, plan)
+        launched = self.protocol_launches - l0
+        if launched:
+            self._ticks_with_dispatch += 1
+        if REC.enabled:
+            REC.complete(CLUSTER_PID, "cluster", "cluster_tick", rec_ts,
+                         dur=round((_time.perf_counter() - t0) * 1e6, 3),
+                         args={"nodes": len(staged), "launches": launched,
+                               "megakernel": self.megakernel})
 
     def _merged_launch(self, staged: List[tuple]) -> None:
         """Stack every plan's recorded kernel inputs into at most one key
@@ -156,6 +242,8 @@ class ClusterTickEngine:
         lane_nodes = set()
         for res, node, plans in staged:
             mergeable = res.num_buckets == res0.num_buckets
+            if not mergeable:
+                self._warn_config(res, res0)
             for plan in plans:
                 if not mergeable:
                     # heterogeneous resolver config: this plan launches its
@@ -183,6 +271,10 @@ class ClusterTickEngine:
                                       res0._pad_range_block,
                                       res0.pad_node_tiers)
         mesh = getattr(res0, "mesh", None)
+        if self.megakernel and mesh is None:
+            self._megakernel_launch(staged, key_entries, rng_entries,
+                                    km, rm, lane_nodes, nl, res0)
+            return
         if mesh is not None:
             from accord_tpu.parallel.mesh import sharded_node_tick
             packed, rpacked, kpacked = sharded_node_tick(
@@ -200,6 +292,24 @@ class ClusterTickEngine:
             if merge is not None:
                 self._rows_used += merge.rows_used
                 self._rows_total += merge.rows_padded
+        # unfused launch ledger: the merged dispatches, each plan's demux
+        # lane_slice calls, every finalize launch, and unmerged plans'
+        # own resolve kernels
+        merged_ids = ({id(p) for p, _ in key_entries}
+                      | {id(p) for p, _ in rng_entries})
+        self.protocol_launches += ndisp + len(key_entries)
+        for _p, args in rng_entries:
+            self.protocol_launches += (int(bool(args["has_r"]))
+                                       + int(bool(args["has_k"])))
+        for res, node, plans in staged:
+            for plan in plans:
+                self.protocol_launches += (
+                    len(plan.fin_calls) + len(plan.rfin_calls)
+                    + len(plan.kfin_calls))
+                if id(plan) not in merged_ids:
+                    self.protocol_launches += (
+                        (plan.key_call is not None)
+                        + (plan.range_call is not None))
         if km is not None:
             for (plan, _args), (r0, b, wlo, w) in zip(key_entries, km.spans):
                 plan.key_call = (
@@ -219,10 +329,156 @@ class ClusterTickEngine:
             for plan in plans:
                 res._launch(node, plan)
 
+    def _warn_config(self, res, res0) -> None:
+        """Satellite diagnostics for heterogeneous resolver configs: the
+        mismatch is counted per plan in mesh_tick_fallbacks; here it is
+        logged ONCE per config-pair signature so a misconfigured cluster
+        is visible without flooding the burn."""
+        sig = (type(res).__name__, res.num_buckets,
+               type(res0).__name__, res0.num_buckets)
+        if sig in self._warned_cfgs:
+            return
+        self._warned_cfgs.add(sig)
+        logger.warning(
+            "mesh tick: resolver config %s(num_buckets=%s) cannot merge "
+            "with %s(num_buckets=%s); its plans launch unfused "
+            "(counted in mesh_tick_fallbacks)", *sig)
+
+    def _megakernel_launch(self, staged, key_entries, rng_entries, km, rm,
+                           lane_nodes, nl, res0) -> None:
+        """ONE fused device program for the whole cluster tick
+        (ops/kernels.protocol_tick): the merged key+range resolve, every
+        merged plan's finalize compaction demuxed in-kernel at its merge
+        span, and the quorum count over the drains' deferred cmd lanes.
+        Plan calls are swapped for host-side views/results of the fused
+        outputs (node_lane.MergedView slices the one contiguous readback),
+        then every plan launches through the stock path -- fault draws,
+        harvest scheduling, decode, and generation pins are untouched, so
+        histories stay bit-identical to the unfused merge and to the
+        per-node loop."""
+        import jax.numpy as jnp
+
+        from accord_tpu.ops.kernels import protocol_tick
+        from accord_tpu.ops.tiers import mega_lane_tier
+
+        key_in = rng_in = None
+        if km is not None:
+            key_in = (jnp.asarray(km.subj_of), jnp.asarray(km.subj_keys),
+                      jnp.asarray(km.subj_node), jnp.asarray(km.sb),
+                      jnp.asarray(km.sknd), jnp.asarray(km.slots),
+                      km.blocks)
+        if rm is not None:
+            rng_in = (jnp.asarray(rm.iv_of), jnp.asarray(rm.iv_s),
+                      jnp.asarray(rm.iv_e), jnp.asarray(rm.subj_node),
+                      jnp.asarray(rm.sb), jnp.asarray(rm.sknd),
+                      jnp.asarray(rm.srng), jnp.asarray(rm.r_slots),
+                      rm.r_blocks, jnp.asarray(rm.k_slots), rm.k_blocks)
+        # finalize specs, index-aligned with each plan's deferred calls
+        fins: List[tuple] = []
+        fin_sched: List[tuple] = []     # (plan, "fin"|"rfin"|"kfin", gi)
+        if km is not None:
+            for (plan, _args), (r0, b, wlo, w) in zip(key_entries, km.spans):
+                for gi, (_g, spec) in enumerate(plan.fin_args):
+                    (_k, kid_rows, j_subj, j_kid, j_srow, act_ts,
+                     off, oc) = spec
+                    fins.append(("key", r0, wlo, b, w, off, kid_rows,
+                                 j_subj, j_kid, j_srow, act_ts, oc))
+                    fin_sched.append((plan, "fin", gi))
+        if rm is not None:
+            for (plan, _args), (r0, b, _rwlo, _rw, kwlo, kw) \
+                    in zip(rng_entries, rm.spans):
+                for gi, (_g, spec) in enumerate(plan.rfin_args):
+                    iv0, iv1, iv2, j_ok, j_sb, j_sknd, rsnap, oc = spec
+                    fins.append(("range", iv0, iv1, iv2, j_ok, j_sb,
+                                 j_sknd, rsnap, oc))
+                    fin_sched.append((plan, "rfin", gi))
+                for gi, (_g, spec) in enumerate(plan.kfin_args):
+                    (_k, kid_rows, j_subj, j_kid, j_srow, act_ts,
+                     off, oc) = spec
+                    fins.append(("rkey", r0, kwlo, b, kw, off, kid_rows,
+                                 j_subj, j_kid, j_srow, act_ts, oc))
+                    fin_sched.append((plan, "kfin", gi))
+        # stack the drains' deferred cmd transition lanes for the quorum
+        # count, padded to the MEGA_LANE_TIERS ladder
+        lanes, self._cmd_lanes = self._cmd_lanes, []
+        quorum = None
+        q_txn_np = None
+        if lanes:
+            q_txn = np.concatenate([t for t, _, _ in lanes])
+            q_ts = np.concatenate([t for _, t, _ in lanes])
+            q_code = np.concatenate([c for _, _, c in lanes])
+            nlanes = q_txn.shape[0]
+            t = mega_lane_tier(nlanes)
+            pt = np.zeros((t, 3), np.int32)
+            pt[:nlanes] = q_txn
+            ps = np.full((t, 3), np.iinfo(np.int32).min, np.int32)
+            ps[:nlanes] = q_ts
+            pc = np.zeros(t, np.int32)
+            pc[:nlanes] = q_code
+            pv = np.zeros(t, bool)
+            pv[:nlanes] = True
+            quorum = (jnp.asarray(pt), jnp.asarray(ps), jnp.asarray(pc),
+                      jnp.asarray(pv))
+            q_txn_np = q_txn
+        if km is not None or rm is not None or fins or quorum is not None:
+            packed_out, rng_out, fin_outs, _cmd, q_out = protocol_tick(
+                res0._table, key_in=key_in, rng_in=rng_in,
+                fins=tuple(fins), quorum=quorum,
+                quorum_size=self.quorum_size)
+            self.megakernel_dispatches += 1
+            self.protocol_launches += 1
+            if km is not None or rm is not None:
+                self.node_lane_dispatches += 1
+                self._nodes_in_dispatches += len(lane_nodes)
+            for merge in (km, rm):
+                if merge is not None:
+                    self._rows_used += merge.rows_used
+                    self._rows_total += merge.rows_padded
+            if quorum is not None:
+                # q_out[2] (quorum met per lane) reads back lazily next tick
+                self._pending_quorum.append((q_out[2], q_txn_np))
+            # swap each merged plan's deferred calls for host-side views of
+            # the fused outputs: demux is slicing of the one contiguous
+            # readback -- no further device dispatches this tick
+            if km is not None:
+                pbuf = nl.MergedBuffer(packed_out)
+                for (plan, _args), (r0, b, wlo, w) \
+                        in zip(key_entries, km.spans):
+                    plan.key_call = (
+                        lambda v=nl.MergedView(pbuf, r0, b, wlo, w): v)
+            if rm is not None:
+                rbuf = nl.MergedBuffer(rng_out[0])
+                kbuf = nl.MergedBuffer(rng_out[1])
+                for (plan, args), (r0, b, rwlo, rw, kwlo, kw) \
+                        in zip(rng_entries, rm.spans):
+                    rv = (nl.MergedView(rbuf, r0, b, rwlo, rw)
+                          if args["has_r"] else None)
+                    kv = (nl.MergedView(kbuf, r0, b, kwlo, kw)
+                          if args["has_k"] else None)
+                    plan.range_call = (lambda rv=rv, kv=kv: (rv, kv))
+            for (plan, lane, gi), out_i in zip(fin_sched, fin_outs):
+                calls = getattr(plan, lane + "_calls")
+                g, _fn = calls[gi]
+                calls[gi] = (g, (lambda *_a, o=out_i: o))
+        # launch every plan through the stock path; unmerged (fallback)
+        # plans fire their own kernels and are ledgered loop-style
+        merged_ids = ({id(p) for p, _ in key_entries}
+                      | {id(p) for p, _ in rng_entries})
+        for res, node, plans in staged:
+            for plan in plans:
+                if id(plan) not in merged_ids:
+                    self.protocol_launches += (
+                        (plan.key_call is not None)
+                        + (plan.range_call is not None)
+                        + len(plan.fin_calls) + len(plan.rfin_calls)
+                        + len(plan.kfin_calls))
+                res._launch(node, plan)
+
 
 def run_mesh_burn(seed: int, ops: int = 500, *, nodes: int = 8,
                   rf: int = 3, num_shards: Optional[int] = None,
                   stores_per_node: int = 2, mesh_tick: bool = True,
+                  megakernel: bool = False,
                   key_count: int = 64, concurrency: int = 16,
                   batch_window_ms: float = 2.0,
                   device_latency_ms: float = 4.0,
@@ -238,12 +494,16 @@ def run_mesh_burn(seed: int, ops: int = 500, *, nodes: int = 8,
     """Run one seeded burn with the whole cluster ticked by a
     ClusterTickEngine. mesh_tick=True launches every node's resolve as one
     node-lane dispatch per cluster tick; mesh_tick=False launches the same
-    plans through the per-node Python loop (the bit-identical baseline).
+    plans through the per-node Python loop (the bit-identical baseline);
+    megakernel=True fuses the whole tick into one protocol_tick program
+    (single device -- the sharded path keeps the unfused dispatch pair).
     Returns (report, engine) -- the report's counters already carry the
     engine's node-lane metrics."""
     from accord_tpu.ops.resolver import BatchDepsResolver
 
-    eng = engine or ClusterTickEngine(mesh_tick=mesh_tick)
+    eng = engine or ClusterTickEngine(mesh_tick=mesh_tick,
+                                      megakernel=megakernel)
+    eng.quorum_size = min(rf, nodes) // 2 + 1
     rkw = dict(resolver_kwargs or {})
     rkw.setdefault("num_buckets", num_buckets)
     rkw.setdefault("pad_node_tiers", pad_node_tiers)
@@ -294,6 +554,8 @@ def main(argv=None) -> int:
     ap.add_argument("--cmd-plane-authoritative", action="store_true")
     ap.add_argument("--python-loop", action="store_true",
                     help="per-node launch loop (the differential baseline)")
+    ap.add_argument("--megakernel", action="store_true",
+                    help="one fused protocol_tick program per cluster tick")
     ap.add_argument("--reconcile", action="store_true",
                     help="run each seed twice; require identical logs")
     args = ap.parse_args(argv)
@@ -309,7 +571,8 @@ def main(argv=None) -> int:
             crash_restart=args.crash_restart,
             cmd_plane=args.cmd_plane or args.cmd_plane_authoritative,
             cmd_plane_authoritative=args.cmd_plane_authoritative,
-            mesh_tick=not args.python_loop)
+            mesh_tick=not args.python_loop,
+            megakernel=args.megakernel)
         try:
             r, eng = run_mesh_burn(seed, collect_log=args.reconcile,
                                    **kwargs)

@@ -1441,10 +1441,10 @@ def bench_mesh_burn(quick: bool):
     3x the loop at >= 64 nodes (the loop fires one resolve kernel per
     node plan; the merge fires at most two per cluster tick -- on
     dispatch-bound accelerators this collapse IS the committed-txn/s
-    win), and the node-lane kernels mint ZERO compiles in the timed
-    sweep after the warm pass, across every node-count change
-    (`lane_slice` demux is excluded by the documented warmup
-    convention -- it compiles per span shape, not per node count).
+    win), and the FULL kernel surface -- `lane_slice` demux included,
+    now that harvest spans pad to the node-block width tiers -- mints
+    ZERO compiles in the timed sweep after the warm pass, across every
+    node-count change.
     Wall-clock committed/s for both modes is reported un-gated: on CPU
     a dispatch is a function call, so the host-side block stacking can
     outweigh the collapse it buys; the structural ratio is the portable
@@ -1463,13 +1463,14 @@ def bench_mesh_burn(quick: bool):
     warmup(num_buckets=128, cap=4096, batch_tiers=(8,), scatter_tiers=(8,),
            store_tiers=(1, 2), node_tiers=(2, 4))
 
-    # warm pass: one mesh-tick burn per size, SAME seed/kwargs as the
-    # timed leg, so every node-kernel shape the sweep can reach is
-    # compiled before the snapshot
+    # warm pass: one burn per size AND mode, SAME seed/kwargs as the
+    # timed legs, so every kernel shape the sweep can reach is compiled
+    # before the snapshot (the widened gate below covers the FULL
+    # jit_cache_sizes surface, loop-mode per-node kernels included)
     for nodes, ops in sizes:
         run_mesh_burn(seed, ops, nodes=nodes, mesh_tick=True)
-    cache0 = {k: v for k, v in jit_cache_sizes().items()
-              if k.startswith("node_fused")}
+        run_mesh_burn(seed, ops, nodes=nodes, mesh_tick=False)
+    cache0 = jit_cache_sizes()
 
     results = {}
     for nodes, ops in sizes:
@@ -1515,12 +1516,14 @@ def bench_mesh_burn(quick: bool):
                 f"{per_dispatch:.2f}x the per-node loop "
                 f"({loop_calls} loop calls vs {mesh_calls} merged; gate 3x)")
 
-    cache1 = {k: v for k, v in jit_cache_sizes().items()
-              if k.startswith("node_fused")}
+    cache1 = jit_cache_sizes()
     if cache1 != cache0:
+        diff = {k: (cache0.get(k), cache1.get(k))
+                for k in set(cache0) | set(cache1)
+                if cache0.get(k) != cache1.get(k)}
         raise AssertionError(
-            f"node-lane kernels recompiled across node-count changes in "
-            f"the timed sweep: {cache0} -> {cache1}")
+            f"tick-path kernels recompiled across node-count changes in "
+            f"the timed sweep: {diff}")
 
     # MULTICHIP: the same differential through sharded_node_tick (node
     # blocks over 'data', buckets over 'model'). Virtual devices must be
@@ -1558,6 +1561,140 @@ def bench_mesh_burn(quick: bool):
         "seed": seed,
         "sweep": {str(n): r for n, r in results.items()},
         "node_kernel_recompiles_in_sweep": 0,    # asserted above
+        "multichip": multichip,
+    }
+
+
+# ---------------------------------------------------------------------------
+# 5c. protocol megakernel: one fused device call per cluster tick
+# ---------------------------------------------------------------------------
+
+def bench_megakernel(quick: bool):
+    """Megakernel sweep at 64/256/1024 nodes: the fused protocol_tick
+    (resolve + finalize-CSR + quorum in ONE program per cluster tick) vs
+    the unfused <=2-dispatch merge. Hard gates per size: bit-identical
+    event logs, `launches_per_tick` exactly 1.0 for the fused engine,
+    committed txns PER DEVICE LAUNCH strictly above the unfused path
+    (the unfused tick pays a launch per plan finalize + demux slice; the
+    fused tick pays one -- on dispatch-bound accelerators that collapse
+    IS the committed-txn/s win), and zero compiles minted in the timed
+    sweep across the FULL jit_cache_sizes surface, protocol_tick and
+    lane_slice included. Wall-clock committed/s rides along un-gated,
+    same convention as bench_mesh_burn: on the CPU backend both modes
+    are bound by identical host-side encode, so the wall ratio hovers at
+    ~1 and the structural ratio is the portable number. A MULTICHIP leg
+    asserts the single-device-by-design guard: a megakernel engine on
+    the sharded 8-device mesh must fall back to the unfused pair and
+    still commit bit-identical histories."""
+    from accord_tpu.ops.kernels import jit_cache_sizes
+    from accord_tpu.sim.mesh_burn import run_mesh_burn
+
+    sizes = (((64, 40), (256, 30), (1024, 10)) if quick else
+             ((64, 120), (256, 50), (1024, 24)))
+    seed = 6
+
+    # warm pass: both engine modes per size, SAME seed/kwargs as the
+    # timed legs, so every static signature the sweep can reach
+    # (protocol_tick variants included) is compiled before the snapshot
+    for nodes, ops in sizes:
+        run_mesh_burn(seed, ops, nodes=nodes, mesh_tick=True,
+                      megakernel=True)
+        run_mesh_burn(seed, ops, nodes=nodes, mesh_tick=True)
+    cache0 = jit_cache_sizes()
+
+    results = {}
+    for nodes, ops in sizes:
+        t0 = time.perf_counter()
+        mega, meng = run_mesh_burn(seed, ops, nodes=nodes, mesh_tick=True,
+                                   megakernel=True, collect_log=True)
+        mega_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        unf, ueng = run_mesh_burn(seed, ops, nodes=nodes, mesh_tick=True,
+                                  collect_log=True)
+        unf_s = time.perf_counter() - t0
+        if mega.log != unf.log:
+            raise AssertionError(
+                f"{nodes}-node megakernel burn diverged from the unfused "
+                f"path ({len(mega.log)} vs {len(unf.log)} entries)")
+        msnap, usnap = meng.snapshot(), ueng.snapshot()
+        if msnap["megakernel_dispatches"] <= 0:
+            raise AssertionError(f"{nodes}-node: no fused dispatch fired")
+        if msnap["launches_per_tick"] != 1.0:
+            raise AssertionError(
+                f"{nodes}-node fused burn took "
+                f"{msnap['launches_per_tick']:.2f} launches per tick "
+                f"(gate: exactly 1)")
+        per_launch = (mega.acked / max(meng.protocol_launches, 1)) \
+            / max(unf.acked / max(ueng.protocol_launches, 1), 1e-9)
+        if per_launch <= 1.0:
+            raise AssertionError(
+                f"{nodes}-node committed txns per device launch only "
+                f"{per_launch:.2f}x the unfused path "
+                f"({meng.protocol_launches} fused launches vs "
+                f"{ueng.protocol_launches}; gate: strictly above 1)")
+        results[nodes] = {
+            "ops": ops,
+            "acked": mega.acked,
+            "cluster_ticks": msnap["cluster_ticks"],
+            "megakernel_dispatches": msnap["megakernel_dispatches"],
+            "launches_per_tick": msnap["launches_per_tick"],
+            "unfused_launches_per_tick": round(
+                usnap["launches_per_tick"], 2),
+            "committed_per_launch_speedup": round(per_launch, 2),
+            "mega_committed_per_s": round(mega.acked / max(mega_s, 1e-9), 1),
+            "unfused_committed_per_s": round(unf.acked / max(unf_s, 1e-9), 1),
+            "wall_ratio": round((mega.acked / max(mega_s, 1e-9))
+                                / max(unf.acked / max(unf_s, 1e-9), 1e-9),
+                                2),
+            "history_identical": True,
+        }
+
+    cache1 = jit_cache_sizes()
+    if cache1 != cache0:
+        diff = {k: (cache0.get(k), cache1.get(k))
+                for k in set(cache0) | set(cache1)
+                if cache0.get(k) != cache1.get(k)}
+        raise AssertionError(
+            f"megakernel sweep minted compiles in the timed window: {diff}")
+
+    # MULTICHIP: megakernel=True on the sharded mesh must take the
+    # single-device guard (fused dispatches stay 0, the sharded unfused
+    # pair runs) and still match the per-node loop bit for bit
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8"
+                          ).strip())
+    snippet = (
+        "import json, jax\n"
+        "from accord_tpu.sim.mesh_burn import run_mesh_burn\n"
+        "rkw = dict(num_buckets=256, initial_cap=512)\n"
+        "kw = dict(nodes=4, sharded=True, collect_log=True,\n"
+        "          resolver_kwargs=rkw)\n"
+        f"sh, eng = run_mesh_burn({seed}, 40, mesh_tick=True,\n"
+        f"                        megakernel=True, **kw)\n"
+        f"lp, _ = run_mesh_burn({seed}, 40, mesh_tick=False, **kw)\n"
+        "assert sh.log == lp.log, 'MULTICHIP megakernel burn diverged'\n"
+        "snap = eng.snapshot()\n"
+        "assert snap['megakernel_dispatches'] == 0, \\\n"
+        "    'sharded mesh must not take the single-device fused path'\n"
+        "print(json.dumps({'devices': len(jax.devices()),\n"
+        "                  'megakernel_dispatches': 0,\n"
+        "                  'history_identical': True}))\n")
+    out = subprocess.run([sys.executable, "-c", snippet], env=env,
+                         capture_output=True, text=True, timeout=900)
+    if out.returncode != 0:
+        raise AssertionError(
+            f"MULTICHIP megakernel leg failed: {out.stderr[-800:]}")
+    multichip = json.loads(out.stdout.strip().splitlines()[-1])
+    if multichip["devices"] < 8:
+        raise AssertionError(
+            f"MULTICHIP megakernel leg ran on {multichip['devices']} devices")
+
+    return {
+        "seed": seed,
+        "sweep": {str(n): r for n, r in results.items()},
+        "recompiles_in_sweep": 0,    # asserted above
         "multichip": multichip,
     }
 
@@ -1684,6 +1821,7 @@ def main(argv=None) -> int:
         exec_plane = _traced("exec_plane", bench_exec_plane, args.quick)
         cmd_plane = _traced("cmd_plane", bench_cmd_plane, args.quick)
         mesh_burn = _traced("mesh_burn", bench_mesh_burn, args.quick)
+        megakernel = _traced("megakernel", bench_megakernel, args.quick)
         # subprocess leg last: it runs in its OWN processes (each does its
         # own warmup), so the parent's jit caches and trace are untouched
         serve = bench_serve(args.quick)
@@ -1706,6 +1844,7 @@ def main(argv=None) -> int:
                 "exec_plane": exec_plane,
                 "cmd_plane": cmd_plane,
                 "mesh_burn": mesh_burn,
+                "megakernel": megakernel,
                 "serve": serve,
                 "obs_overhead": obs_overhead,
             },

@@ -1,0 +1,292 @@
+"""Protocol megakernel tests (ops/kernels.protocol_tick + megakernel mode
+of sim/mesh_burn.ClusterTickEngine): ONE fused device program per cluster
+tick -- node-lane resolve, in-kernel finalize compaction, deferred
+cmd-plane lanes riding the quorum stage -- against two bit-identical
+baselines (the unfused <=2-dispatch merge and the per-node Python loop).
+The host twin of cmd_tick's PreAccept lane (CmdPlane.defer_batch) gets its
+own unit differential against eval_batch: twin exactness is what reduces
+megakernel bit-identity to already-tested kernel equivalences.
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+
+import numpy as np
+import pytest
+
+from accord_tpu.sim.mesh_burn import ClusterTickEngine, run_mesh_burn
+
+pytestmark = pytest.mark.megakernel
+
+
+def _legs(seed, ops, **kw):
+    mega, eng = run_mesh_burn(seed, ops, mesh_tick=True, megakernel=True,
+                              collect_log=True, **kw)
+    unfused, _ = run_mesh_burn(seed, ops, mesh_tick=True,
+                               collect_log=True, **kw)
+    return mega, eng, unfused
+
+
+class _RecordingEngine(ClusterTickEngine):
+    """Engine that keeps every adopted resolver (for fault-ledger sums)."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.resolvers = []
+
+    def adopt(self, resolver):
+        self.resolvers.append(resolver)
+        return super().adopt(resolver)
+
+
+@pytest.mark.parametrize("seed,ops,ratios", [
+    pytest.param(9, 40, dict(range_read_ratio=0.2, range_write_ratio=0.1),
+                 id="key+range"),
+    pytest.param(4, 80, {}, id="key-only-long", marks=pytest.mark.slow),
+])
+def test_megakernel_vs_unfused_differential(seed, ops, ratios):
+    """Key + range traffic: the fused program commits the exact event log
+    of the unfused merge AND the per-node loop, with every dispatching
+    tick costing exactly ONE device program launch. (The tier-1 leg mixes
+    key and range plans; the longer key-only soak rides the slow lane.)"""
+    mega, eng, unfused = _legs(seed, ops, nodes=4, **ratios)
+    loop, _ = run_mesh_burn(seed, ops, mesh_tick=False,
+                            collect_log=True, nodes=4, **ratios)
+    assert mega.log == unfused.log, f"seed {seed}: fused != unfused"
+    assert mega.log == loop.log, f"seed {seed}: fused != loop"
+    snap = eng.snapshot()
+    assert snap["megakernel_dispatches"] > 0
+    assert snap["launches_per_tick"] == 1.0, snap
+    assert snap["mesh_tick_fallbacks"] == 0
+
+
+@pytest.mark.parametrize("auth,ops", [
+    pytest.param(True, 32, id="authoritative"),
+    pytest.param(False, 60, id="advisory-long", marks=pytest.mark.slow),
+])
+def test_megakernel_cmd_plane_differential(auth, ops):
+    """With the device command plane on (and in authoritative mode), the
+    drains defer PreAccept spans to the host twin; histories must stay
+    bit-identical to the unfused path that dispatches cmd_tick spans
+    synchronously, and the deferred lanes must actually reach the fused
+    quorum stage."""
+    kw = dict(nodes=3, cmd_plane=True, cmd_plane_authoritative=auth)
+    mega, eng, unfused = _legs(13, ops, **kw)
+    assert mega.log == unfused.log, f"authoritative={auth} diverged"
+    snap = eng.snapshot()
+    assert snap["launches_per_tick"] == 1.0, snap
+    assert snap["fastpath_quorum_txns"] > 0, \
+        "no deferred PreAccept lane met the in-kernel quorum"
+
+
+def test_defer_batch_twin_matches_eval_batch():
+    """The host integer twin of cmd_tick's PreAccept lane: defer_batch
+    must return the exact results (outcome, status, executeAt) and leave
+    the exact shadow/clock state of eval_batch on an identical store,
+    without a single device dispatch -- including redundant re-delivery,
+    ballot contention, and mixed batches whose non-PreAccept ops flush
+    the span to the host handler in order."""
+    from accord_tpu.ops.cmd_plane import CmdOp
+    from accord_tpu.primitives.deps import Deps
+    from accord_tpu.primitives.timestamp import Ballot
+    from tests.test_cmd_plane import _env, _mk_txn, _snap
+
+    def _run(defer):
+        _cluster, node, store = _env(True)
+        plane = store.cmd_plane
+        lanes = []
+        sink = lambda t, s, c: lanes.append((t.copy(), s.copy(), c.copy()))  # noqa: E731
+        txns = []
+        for i in range(6):
+            txn = _mk_txn([1 + (i % 4), 5], i + 1)
+            tid = node.next_txn_id(txn.kind, txn.domain)
+            txns.append((tid, txn, node.compute_route(txn)))
+        part = lambda t: t.slice(store.ranges, include_query=False)  # noqa: E731
+        ev = (lambda b: plane.defer_batch(b, sink=sink)) if defer \
+            else plane.eval_batch
+        out = []
+
+        def run(batch):
+            out.append([(r.outcome,
+                         int(r.status) if r.status is not None else None,
+                         r.execute_at) for r in ev(batch)])
+
+        # span 1: fresh preaccepts witnessing each other
+        run([CmdOp.preaccept(t, part(x), r) for t, x, r in txns[:4]])
+        # span 2: redundant re-delivery + ballot contention + fresh
+        run([
+            CmdOp.preaccept(txns[0][0], part(txns[0][1]), txns[0][2]),
+            CmdOp.preaccept(txns[1][0], part(txns[1][1]), txns[1][2],
+                            Ballot(1, 5, 0, 1)),
+            CmdOp.preaccept(txns[4][0], part(txns[4][1]), txns[4][2]),
+        ])
+        # span 3: a commit mid-batch flushes the pending span to the host
+        # handler in order
+        ea = store.command_if_present(txns[2][0]).execute_at
+        run([
+            CmdOp.preaccept(txns[5][0], part(txns[5][1]), txns[5][2]),
+            CmdOp.commit(txns[2][0], txns[2][2], part(txns[2][1]), ea,
+                         Deps.NONE),
+            CmdOp.preaccept(txns[3][0], part(txns[3][1]), txns[3][2],
+                            Ballot(1, 2, 0, 1)),
+        ])
+        snaps = [_snap(store, node, t) for t, _, _ in txns]
+        return out, snaps, plane, lanes
+
+    dev_out, dev_snaps, dev_plane, _ = _run(defer=False)
+    twin_out, twin_snaps, twin_plane, lanes = _run(defer=True)
+    assert twin_out == dev_out
+    assert twin_snaps == dev_snaps
+    # device-honest counters: every PreAccept span rode the twin, and the
+    # ONLY device dispatch is the mid-batch commit (eval_batch puts it on
+    # device, so the twin must too -- host and device Commit handlers
+    # differ observably)
+    assert int(twin_plane.dispatches) == 1
+    assert int(twin_plane.deferred_spans) >= 3
+    assert int(twin_plane.deferred_ops) >= 9
+    assert int(dev_plane.dispatches) > int(twin_plane.dispatches)
+    # the sink lanes mirror the span results: every lane carries (txn, ts)
+    # triples plus an outcome code for the fused quorum stage
+    assert lanes, "defer_batch never emitted quorum lanes"
+    for q_txn, q_ts, q_code in lanes:
+        assert q_txn.shape == q_ts.shape and q_txn.shape[1] == 3
+        assert q_code.shape[0] == q_txn.shape[0]
+
+
+def test_protocol_tick_quorum_count():
+    """Unit check of the fused quorum stage: votes count SUCCESS lanes
+    that echoed their txn id, per distinct txn, against the electorate
+    majority; padding and failed lanes are excluded."""
+    import jax.numpy as jnp
+
+    from accord_tpu.ops.kernels import protocol_tick
+
+    t1, t2 = (1, 10, 3), (1, 11, 4)
+    txn = np.array([t1, t1, t2, t1, (0, 0, 0)], np.int32)
+    ts = np.array([t1, t1, t2, (1, 99, 5), (0, 0, 0)], np.int32)
+    #      fast    fast   fast   slow-path  pad
+    code = np.array([0, 0, 0, 0, 0], np.int32)
+    valid = np.array([True, True, True, True, False])
+    table = jnp.zeros((8, 8), jnp.bfloat16)
+    fast, votes, met = protocol_tick(
+        table, quorum=(jnp.asarray(txn), jnp.asarray(ts),
+                       jnp.asarray(code), jnp.asarray(valid)),
+        quorum_size=2)[4]
+    fast, votes, met = (np.asarray(fast), np.asarray(votes),
+                        np.asarray(met))
+    assert fast.tolist() == [True, True, True, False, False]
+    assert votes.tolist()[:3] == [2, 2, 1]
+    assert met.tolist() == [True, True, False, False, False]
+
+
+@pytest.mark.slow
+def test_compaction_pin_isolation_megakernel():
+    """Tiny arenas force growth/compaction mid-burn: each plan's fused
+    inputs are the encode-time snapshot arrays, so arena churn between
+    encode and the fused launch must not perturb any sibling plan's
+    in-kernel demux span."""
+    mega, eng, unfused = _legs(17, 80, nodes=4, key_count=96,
+                               resolver_kwargs=dict(initial_cap=128))
+    assert mega.acked == unfused.acked == 80
+    assert mega.log == unfused.log, \
+        "arena churn leaked across plans inside the fused program"
+    assert eng.snapshot()["launches_per_tick"] == 1.0
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_megakernel_chaos_parity_and_checksum_fallback():
+    """Device-plane fault injection under the megakernel: fault draws ride
+    the stock per-plan _launch order, corruption lands on each plan's
+    OWN host copy of the shared readback (MergedView returns copies), and
+    every corrupted finalize lane is caught by the checksum word computed
+    INSIDE the fused program. History is bit-identical to the chaos-free
+    run and to the unfused merge under the same chaos schedule."""
+    rates = {"dispatch_exc_rate": 0.05, "stuck_rate": 0.05,
+             "corrupt_rate": 0.10, "overflow_rate": 0.03}
+    kw = dict(nodes=4, key_count=16, write_ratio=0.7)
+
+    def leg(megakernel, chaos):
+        eng = _RecordingEngine(mesh_tick=True, megakernel=megakernel)
+        rep, _ = run_mesh_burn(23, 80, engine=eng, collect_log=True,
+                               device_chaos=chaos,
+                               device_fault_rates=rates if chaos else None,
+                               **kw)
+        return rep, eng
+
+    mega_chaos, eng = leg(True, True)
+    mega_clean, _ = leg(True, False)
+    unfused_chaos, ueng = leg(False, True)
+    assert mega_chaos.log == mega_clean.log, \
+        "injected faults leaked into the fused tick's committed history"
+    assert mega_chaos.log == unfused_chaos.log, \
+        "chaos handling diverged between fused and unfused dispatch"
+    inj = mega_chaos.device_faults
+    assert inj["corrupt"] > 0, "corrupt draws never fired; rates too low"
+    mism = sum(r.checksum_mismatches for r in eng.resolvers)
+    assert mism == inj["corrupt"], (mism, inj)
+    assert mism == sum(r.checksum_mismatches for r in ueng.resolvers) \
+        or unfused_chaos.device_faults["corrupt"] == inj["corrupt"]
+
+
+def test_mixed_resolver_config_falls_back_warns_once(caplog):
+    """Satellite: a cluster whose resolvers disagree on num_buckets cannot
+    merge those plans -- they launch unfused (counted in
+    mesh_tick_fallbacks), the engine logs the config mismatch ONCE per
+    signature, and the committed history is still bit-identical to the
+    per-node loop over the same mixed factory."""
+    from accord_tpu.ops.resolver import BatchDepsResolver
+    from accord_tpu.sim.burn import run_burn
+    from accord_tpu.sim.cluster import ClusterConfig
+
+    def burn(megakernel, mesh_tick=True):
+        eng = ClusterTickEngine(mesh_tick=mesh_tick, megakernel=megakernel)
+        eng.quorum_size = 2
+        counter = itertools.count()
+
+        def factory():
+            nb = 128 if next(counter) % 2 == 0 else 256
+            return eng.adopt(BatchDepsResolver(num_buckets=nb))
+
+        cfg = ClusterConfig(num_nodes=4, rf=3, num_shards=4,
+                            stores_per_node=2,
+                            deps_resolver_factory=factory,
+                            deps_batch_window_ms=2.0,
+                            device_latency_ms=4.0)
+        rep = run_burn(29, 40, nodes=4, rf=3, key_count=32, concurrency=12,
+                       config=cfg, collect_log=True)
+        return rep, eng
+
+    with caplog.at_level(logging.WARNING, "accord_tpu.sim.mesh_burn"):
+        mega, eng = burn(True)
+    # warn-once is per engine: assert on the fused burn's records before
+    # the baseline burns mint their own engines (and their own warnings)
+    warns = [r for r in caplog.records if "cannot merge" in r.message]
+    sigs = {(r.args[0], r.args[1], r.args[2], r.args[3]) for r in warns}
+    assert warns, "heterogeneous config never logged"
+    assert len(warns) == len(sigs), "config mismatch logged more than once"
+    loop, _ = burn(False, mesh_tick=False)
+    mesh, _ = burn(False)
+    assert mega.log == loop.log, "mixed-config fused burn diverged"
+    assert mesh.log == loop.log
+    assert eng.snapshot()["mesh_tick_fallbacks"] > 0
+
+
+@pytest.mark.slow
+def test_megakernel_64_nodes_reconcile():
+    """The acceptance-bar case: 64 nodes, one fused program per tick,
+    bit-identical to the unfused merge, reconcilable with itself, and
+    launches_per_tick exactly 1.0 across the whole burn."""
+    kw = dict(nodes=64, concurrency=24)
+    mega, eng, unfused = _legs(3, 120, **kw)
+    assert mega.acked == unfused.acked == 120
+    assert mega.log == unfused.log
+    again, eng2 = run_mesh_burn(3, 120, mesh_tick=True, megakernel=True,
+                                collect_log=True, **kw)
+    assert mega.log == again.log, "megakernel burn is not reconcilable"
+    for e in (eng, eng2):
+        snap = e.snapshot()
+        assert snap["launches_per_tick"] == 1.0, snap
+        assert snap["megakernel_dispatches"] == snap["cluster_ticks"] or \
+            snap["megakernel_dispatches"] > 0
