@@ -376,6 +376,7 @@ GLOSSARY: Dict[str, str] = {
     "cmd_plane_flush_s": "dirty-lane scatter upload wall seconds",
     "cmd_deferred_spans": "PreAccept spans decided by the host twin for the fused tick",
     "cmd_deferred_ops": "protocol ops deferred through the host twin (megakernel mode)",
+    "cmd_defer_retired": "host-twinned PreAccept spans folded back through the fused repair stage",
     # -- per-node txn lifecycle (Node.metrics) -------------------------------
     "txn.started": "coordinations started on this node",
     "txn.failed": "coordinations failed (timeout/invalidated)",
@@ -408,4 +409,16 @@ GLOSSARY: Dict[str, str] = {
     "megakernel_dispatches": "cluster ticks launched as one fused protocol_tick program",
     "launches_per_tick": "mean device program launches per cluster tick that dispatched",
     "fastpath_quorum_txns": "distinct txns whose PreAccept lanes met the in-kernel fast-path quorum",
+    # -- device message plane (sim/network.DeviceMessageNetwork
+    #    .message_plane_snapshot(), folded into the burn report's counters) ---
+    "device_messages_delivered": "deliveries whose payload came from the device mailbox (verified)",
+    "mailbox_verify_fallbacks": "deliveries where device words mismatched and the host copy won",
+    "mailbox_early_deliveries": "deliveries due before their payload rode a fused launch",
+    "mailbox_depth_high_water": "max occupied slots in any destination mailbox ring",
+    "mailbox_overflow_spills": "messages spilled to the host path (ring full or oversize payload)",
+    "mailbox_bytes_staged": "payload bytes packed into device emit lanes",
+    "mailbox_partition_epochs": "partition-mask uploads (once per link-topology epoch)",
+    "message_plane_batches": "host callbacks that drained the parked-message heap",
+    "message_plane_fires": "message deliveries fired by those drains",
+    "messages_per_host_callback": "mean deliveries collapsed into one host callback (fires/batches)",
 }

@@ -191,6 +191,7 @@ class CmdPlane:
     compactions = RegCounter("cmd_plane_compactions")
     deferred_spans = RegCounter("cmd_deferred_spans")
     deferred_ops = RegCounter("cmd_deferred_ops")
+    defer_retired = RegCounter("cmd_defer_retired")
     flush_s = RegTimer("cmd_plane_flush_s")
 
     def __init__(self, store, initial_cap: int = 1024, key_cap: int = 1024,
@@ -476,6 +477,74 @@ class CmdPlane:
                                      account)
             self._kdirty.clear()
 
+    # -- fused repair (the device-messages megakernel path) ------------------
+
+    def collect_repair(self):
+        """Package the shadows' outstanding flush debt -- the deferred
+        twin's dirty rows/kids plus any host-residual updates -- as one
+        kernels._cmd_repair_body scatter block to ride the next
+        protocol_tick, instead of standalone flush_lane dispatches.
+
+        Returns None when the device arena is not live (a full rebuild is
+        pending; nothing to repair in-kernel), the string "clean" when the
+        arena is live with nothing dirty (an interleaved flush already
+        repaired it), else (block, (rows, kids)). A repair scatters exactly
+        what a flush would -- current shadow values -- so it is idempotent
+        and can never go stale."""
+        with self._lock:
+            if self._device is None or self._device_stale:
+                return None
+            rows = sorted(set().union(*self._dirty.values()))
+            kids = sorted(self._kdirty)
+            if not rows and not kids:
+                return "clean"
+            from accord_tpu.ops.deltas import lane_row_tier
+            rpad = lane_row_tier(max(1, len(rows)))
+            kpad = lane_row_tier(max(1, len(kids)))
+            ridx = np.zeros(rpad, np.intp)
+            ridx[:len(rows)] = rows
+            kidx = np.zeros(kpad, np.intp)
+            kidx[:len(kids)] = kids
+            rows_idx = np.full(rpad, self.cap, np.int32)   # pad -> drop
+            rows_idx[:len(rows)] = rows
+            kid_idx = np.full(kpad, self.kcap, np.int32)
+            kid_idx[:len(kids)] = kids
+            st_v = self.status_h[ridx]
+            fl_v = self.flags_h[ridx]
+            pr_v = self.promised_h[ridx]
+            ab_v = self.accepted_h[ridx]
+            ea_v = self.ea_h[ridx]
+            du_v = self.dur_h[ridx]
+            km_v = self.kmax_h[kidx]
+            kv_v = self.kvalid_h[kidx]
+            self.upload_bytes += (rows_idx.nbytes + st_v.nbytes + fl_v.nbytes
+                                  + pr_v.nbytes + ab_v.nbytes + ea_v.nbytes
+                                  + du_v.nbytes + kid_idx.nbytes
+                                  + km_v.nbytes + kv_v.nbytes)
+            d = self._device
+            block = (d["status"], d["flags"], d["promised"], d["accepted"],
+                     d["execute_at"], d["durability"], d["kmax"],
+                     d["kvalid"], rows_idx, st_v, fl_v, pr_v, ab_v, ea_v,
+                     du_v, kid_idx, km_v, kv_v)
+            return block, (rows, kids)
+
+    def adopt_repair(self, outs, meta, spans: int = 0) -> None:
+        """Take protocol_tick's repaired device columns: the collected
+        rows/kids are clean now (diffed out, not cleared, so anything
+        dirtied since collect_repair stays dirty) and `spans` deferred twin
+        spans retired their flush debt inside the fused program."""
+        with self._lock:
+            rows, kids = meta
+            st, fl, pr, ab, ea, du, km, kv = outs
+            self._device = {"status": st, "flags": fl, "promised": pr,
+                            "accepted": ab, "execute_at": ea,
+                            "durability": du, "kmax": km, "kvalid": kv}
+            rs = set(rows)
+            for name in _LANES:
+                self._dirty[name] -= rs
+            self._kdirty -= set(kids)
+            self.defer_retired += spans
+
     # -- evaluation ----------------------------------------------------------
 
     def eval_batch(self, ops: Sequence[CmdOp]) -> List[CmdResult]:
@@ -648,7 +717,7 @@ class CmdPlane:
     # -- deferred evaluation (the protocol megakernel) -----------------------
 
     def defer_batch(self, ops: Sequence[CmdOp],
-                    sink=None) -> List[CmdResult]:
+                    sink=None, fuse=None) -> List[CmdResult]:
         """eval_batch's megakernel twin: decide each admissible PreAccept
         span with the HOST INTEGER TWIN of cmd_tick's PreAccept lane (the
         drain needs the decisions synchronously, before the tick's single
@@ -662,7 +731,12 @@ class CmdPlane:
         DEVICE span (eval_batch would have put it on device, and device vs
         host handlers differ observably for Commit/Apply), an inadmissible
         op flushes and takes the host handler -- so histories are
-        bit-identical to the device path for any op mix."""
+        bit-identical to the device path for any op mix.
+
+        `fuse` (the device-messages path): called once per nonempty twin
+        span with this plane, registering the span's flush debt for
+        retirement inside the next protocol_tick via collect_repair()
+        instead of a standalone flush_lane dispatch."""
         with self._lock:
             results: List[Optional[CmdResult]] = [None] * len(ops)
             run: List[Tuple[int, CmdOp]] = []
@@ -672,7 +746,7 @@ class CmdPlane:
                 if adm and op.kind == CMD_OP_PREACCEPT:
                     run.append((i, op))
                     continue
-                self._twin_run(run, results, sink)
+                self._twin_run(run, results, sink, fuse)
                 run = []
                 if adm:
                     self._run_device([(i, op)], results)
@@ -680,11 +754,12 @@ class CmdPlane:
                     self.fallbacks += 1
                     results[i] = self._host_one(op)
                     store_ok = self._store_ok()
-            self._twin_run(run, results, sink)
+            self._twin_run(run, results, sink, fuse)
             return results   # type: ignore[return-value]
 
     def _twin_run(self, run: List[Tuple[int, CmdOp]],
-                  results: List[Optional[CmdResult]], sink=None) -> None:
+                  results: List[Optional[CmdResult]], sink=None,
+                  fuse=None) -> None:
         """Sequential host integer twin of cmd_tick's PreAccept lane over
         one admissible span: same gathers, same predicates, same unique_now
         arithmetic, same writebacks -- executed op by op against the shadow
@@ -797,6 +872,8 @@ class CmdPlane:
         node._last_hlc = clock
         self.deferred_spans += 1
         self.deferred_ops += n
+        if fuse is not None:
+            fuse(self)
         if sink is not None:
             sink(q_txn, q_ts, q_code)
         for (i, op), j in zip(run, range(n)):

@@ -81,20 +81,26 @@ class DeviceFaultPlane:
 
     def __init__(self, rng, *, dispatch_exc_rate: float = 0.0,
                  stuck_rate: float = 0.0, corrupt_rate: float = 0.0,
-                 overflow_rate: float = 0.0, dispatch_exc_burst: int = 4,
-                 stuck_probes_max: int = 6):
+                 overflow_rate: float = 0.0, mailbox_rate: float = 0.0,
+                 dispatch_exc_burst: int = 4, stuck_probes_max: int = 6):
         self.rng = rng
         self.rates: Dict[str, float] = {
             "dispatch_exc": dispatch_exc_rate,
             "stuck": stuck_rate,
             "corrupt": corrupt_rate,
             "overflow": overflow_rate,
+            # NOT in FAULT_KINDS / draw(): mailbox corruption is drawn at
+            # the message plane's landed-readback point, not per dispatch,
+            # so enabling it never shifts the dispatch fault stream of an
+            # existing chaos seed
+            "mailbox": mailbox_rate,
         }
         self.dispatch_exc_burst = max(1, dispatch_exc_burst)
         self.stuck_probes_max = max(1, stuck_probes_max)
         # injections actually APPLIED (a corrupt draw on a call with no
         # finalized buffer is dropped, not counted), per kind
-        self.injected: Dict[str, int] = {k: 0 for k in FAULT_KINDS}
+        self.injected: Dict[str, int] = {k: 0 for k in
+                                         FAULT_KINDS + ("mailbox",)}
 
     @property
     def total_injected(self) -> int:
@@ -136,6 +142,33 @@ class DeviceFaultPlane:
         bit = self.rng.next_int(32)
         flat[pos] ^= np.uint32(1) << np.uint32(bit)
         self.note("corrupt")
+        return True
+
+    def corrupt_mailbox(self, words: np.ndarray) -> bool:
+        """Maybe flip one bit of a landed mailbox message's word lanes (a
+        local copy the caller owns) -- the simulated corrupted device
+        routing. Drawn at the delivery readback point; the message plane's
+        verify-against-staged-bytes contract catches every injection and
+        falls back to the host copy, so chaos histories stay bit-identical.
+        Draws nothing when the mailbox rate is zero (stream stability)."""
+        rate = self.rates.get("mailbox", 0.0)
+        if rate <= 0.0 or words.size == 0 or not self.rng.decide(rate):
+            return False
+        flat = words.reshape(-1).view(np.uint32)
+        # flip within the LIVE bytes (payload, else the length header),
+        # never the zero padding -- a padding flip would be invisible to
+        # the unpack and the injection ledger must match observable
+        # verify fallbacks exactly
+        nbytes = int(flat[0] & 0x7FFFFFFF)
+        as_bytes = words.reshape(-1).view(np.uint8)
+        limit = min(nbytes, int(as_bytes.shape[0]) - 4)
+        if limit > 0:
+            pos = 4 + self.rng.next_int(limit)
+        else:
+            pos = self.rng.next_int(4)  # empty payload: corrupt the header
+        bit = self.rng.next_int(8)
+        as_bytes[pos] ^= np.uint8(1) << np.uint8(bit)
+        self.note("mailbox")
         return True
 
 

@@ -1233,16 +1233,40 @@ def _cmd_tick_body(status, flags, promised, accepted, execute_at, durability,
 _PROTOCOL_TICK_FNS: dict = {}
 
 
+def _cmd_repair_body(status, flags, promised, accepted, execute_at,
+                     durability, kmax, kvalid, rows_idx, st_v, fl_v, pr_v,
+                     ab_v, ea_v, du_v, kid_idx, km_v, kv_v):
+    """One CmdPlane's deferred-twin repair: scatter the host shadows'
+    current values over the dirty rows/kids INSIDE the fused program, so
+    the device-messages path retires the twin's flush debt without a
+    standalone flush_lane dispatch. Idempotent by construction (a repair
+    writes exactly what a flush would), so staleness is impossible;
+    padding indices point one past the cap and drop."""
+    status = status.at[rows_idx].set(st_v, mode="drop")
+    flags = flags.at[rows_idx].set(fl_v, mode="drop")
+    promised = promised.at[rows_idx].set(pr_v, mode="drop")
+    accepted = accepted.at[rows_idx].set(ab_v, mode="drop")
+    execute_at = execute_at.at[rows_idx].set(ea_v, mode="drop")
+    durability = durability.at[rows_idx].set(du_v, mode="drop")
+    kmax = kmax.at[kid_idx].set(km_v, mode="drop")
+    kvalid = kvalid.at[kid_idx].set(kv_v, mode="drop")
+    return (status, flags, promised, accepted, execute_at, durability,
+            kmax, kvalid)
+
+
 def _protocol_tick_fn(statics):
     fn = _PROTOCOL_TICK_FNS.get(statics)
     if fn is not None:
         return fn
-    has_key, has_rng, fin_statics, cmd_promotes, qsize = statics
+    has_key, has_rng, fin_statics, cmd_promotes, qsize, has_mail, \
+        n_repairs = statics
     # node_lane imports from this module -- resolve lazily (first call
     # always happens after the engine imported it)
     from accord_tpu.ops import node_lane as _nl
+    from accord_tpu.ops.mailbox import _mailbox_route_body
 
-    def run(witness_table, key_in, rng_in, fin_in, cmd_in, q_in):
+    def run(witness_table, key_in, rng_in, fin_in, cmd_in, q_in,
+            mail_in, rep_in):
         packed = ()
         rng_out = ()
         if has_key:
@@ -1281,7 +1305,13 @@ def _protocol_tick_fn(statics):
             same = jnp.all(q_txn[:, None, :] == q_txn[None, :, :], axis=2)
             votes = jnp.sum(same & fast[None, :], axis=1, dtype=jnp.int32)
             q_out = (fast, votes, fast & (votes >= qsize))
-        return packed, rng_out, tuple(fin_outs), tuple(cmd_outs), q_out
+        mail_out = ()
+        if has_mail:
+            mail_out = _mailbox_route_body(*mail_in)
+        rep_outs = tuple(_cmd_repair_body(*rep_in[i])
+                         for i in range(n_repairs))
+        return (packed, rng_out, tuple(fin_outs), tuple(cmd_outs), q_out,
+                mail_out, rep_outs)
 
     fn = jax.jit(run)
     _PROTOCOL_TICK_FNS[statics] = fn
@@ -1289,10 +1319,12 @@ def _protocol_tick_fn(statics):
 
 
 def protocol_tick(witness_table, key_in=None, rng_in=None, fins=(),
-                  cmds=(), quorum=None, quorum_size=1):
+                  cmds=(), quorum=None, quorum_size=1, mailbox=None,
+                  cmd_repairs=()):
     """Launch the fused cluster-tick program: ONE device dispatch covering
-    deps resolve, finalize compaction, cmd transitions, and the fast-path
-    quorum count.
+    deps resolve, finalize compaction, cmd transitions, the fast-path
+    quorum count, the device-message mailbox routing stage, and any
+    CmdPlane repair scatters.
 
     key_in:  node_fused_deps_resolve's args minus witness_table, or None
     rng_in:  node_fused_range_deps_resolve's args minus witness_table
@@ -1311,8 +1343,13 @@ def protocol_tick(witness_table, key_in=None, rng_in=None, fins=(),
     quorum:  (txn i32[t,3], ts i32[t,3], code i32[t], valid bool[t]) lanes
              from the tick's PreAccept spans, padded to a MEGA_LANE_TIERS
              tier; quorum_size the electorate majority (static).
+    mailbox: ops/mailbox.MailboxPlane.stage_batch's input tuple (arena,
+             meta, emit lanes, partition mask) for the fused routing
+             stage, or None.
+    cmd_repairs: CmdPlane.collect_repair blocks (18 arrays each, see
+             _cmd_repair_body) retiring deferred-twin flush debt in-kernel.
     -> (packed, (rpacked, kpacked), fin_outs, cmd_outs,
-        (fast, votes, met)); absent stages return ().
+        (fast, votes, met), mail_out, rep_outs); absent stages return ().
     """
     fin_statics, fin_traced = [], []
     for f in fins:
@@ -1332,21 +1369,24 @@ def protocol_tick(witness_table, key_in=None, rng_in=None, fins=(),
     cmd_statics = tuple(bool(c[-1]) for c in cmds)
     cmd_traced = tuple(tuple(c[:-1]) for c in cmds)
     statics = (key_in is not None, rng_in is not None, tuple(fin_statics),
-               cmd_statics, int(quorum_size) if quorum is not None else None)
+               cmd_statics, int(quorum_size) if quorum is not None else None,
+               mailbox is not None, len(cmd_repairs))
     fn = _protocol_tick_fn(statics)
-    packed, rng_out, fin_outs, cmd_outs, q_out = fn(
+    packed, rng_out, fin_outs, cmd_outs, q_out, mail_out, rep_outs = fn(
         witness_table,
         tuple(key_in) if key_in is not None else (),
         tuple(rng_in) if rng_in is not None else (),
         tuple(fin_traced), cmd_traced,
-        tuple(quorum) if quorum is not None else ())
+        tuple(quorum) if quorum is not None else (),
+        tuple(mailbox) if mailbox is not None else (),
+        tuple(tuple(r) for r in cmd_repairs))
     if order != list(range(len(order))):
         # undo the canonical sort: callers demux fin_outs positionally
         back = [0] * len(order)
         for pos, i in enumerate(order):
             back[i] = pos
         fin_outs = tuple(fin_outs[back[i]] for i in range(len(order)))
-    return packed, rng_out, fin_outs, cmd_outs, q_out
+    return packed, rng_out, fin_outs, cmd_outs, q_out, mail_out, rep_outs
 
 
 def protocol_tick_cache_sizes() -> int:

@@ -2181,9 +2181,16 @@ class BatchDepsResolver(DepsResolver):
                 if td is not None and getattr(td, "cmd_defer", False):
                     # megakernel mode: decide the span with the host twin
                     # now and ride the device transition lanes into the
-                    # tick's single fused dispatch (the quorum stage)
+                    # tick's single fused dispatch (the quorum stage); on
+                    # the device-messages path the span's shadow writes
+                    # also fold back in-kernel as a repair scatter instead
+                    # of a later standalone flush
+                    fuse = (getattr(td, "note_cmd_defer", None)
+                            if getattr(td, "device_messages", False)
+                            else None)
                     res = plane.defer_batch(cmd_ops,
-                                            sink=td.note_cmd_lanes)
+                                            sink=td.note_cmd_lanes,
+                                            fuse=fuse)
                 else:
                     d0 = int(plane.dispatches)
                     res = plane.eval_batch(cmd_ops)

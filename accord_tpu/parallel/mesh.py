@@ -62,6 +62,19 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(dev_array, ("data", "model"))
 
 
+def mesh_supports_message_plane(mesh: Mesh) -> bool:
+    """Whether the device mailbox plane may fuse into sharded programs.
+
+    The mailbox scatter stage (ops/mailbox._mailbox_route_body) assumes a
+    replicated arena: every emit lane may target any destination row, so a
+    'data'-sharded arena would need a cross-shard permute collective that the
+    single-dispatch protocol_tick deliberately does not carry. Until that
+    collective exists, sharded runs keep replica traffic on the host path and
+    only single-mesh (or replicated) programs ride the device plane.
+    """
+    return False
+
+
 def sharded_deps_step(mesh: Mesh, closure_iters: int = 8):
     """Build the jitted multi-chip deps step.
 

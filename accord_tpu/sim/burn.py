@@ -75,9 +75,12 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
              max_range_width: int = 2048,
              device_chaos: bool = False,
              device_fault_rates: Optional[Dict[str, float]] = None,
+             device_messages: bool = False,
              config: Optional[ClusterConfig] = None,
              collect_log: bool = False) -> BurnReport:
     cfg = config or ClusterConfig(num_nodes=nodes, rf=rf)
+    if device_messages:
+        cfg.device_messages = True
     cluster = Cluster(seed, cfg)
     wl_rng = cluster.rng.fork()
     chaos_rng = cluster.rng.fork()
@@ -340,6 +343,9 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
                 for k, v in store.cmd_plane.snapshot().items():
                     if isinstance(v, (int, float)):
                         report.counters[k] = report.counters.get(k, 0) + v
+    # device message plane counters (empty dict on the host baseline)
+    for k, v in cluster.network.message_plane_snapshot().items():
+        report.counters[k] = v
     from accord_tpu.obs.metrics import MetricsRegistry
     report.registry = MetricsRegistry()
     for node in cluster.nodes.values():
@@ -376,6 +382,9 @@ def main(argv=None) -> int:
     ap.add_argument("--device-chaos", action="store_true",
                     help="device resolvers + seeded device-plane fault "
                          "injection (see ops/fault_plane.py)")
+    ap.add_argument("--device-messages", action="store_true",
+                    help="route replica traffic through the device mailbox "
+                         "plane fused into protocol_tick (see sim/network.py)")
     ap.add_argument("--reconcile", action="store_true",
                     help="run each seed twice; require identical logs")
     args = ap.parse_args(argv)
@@ -409,7 +418,8 @@ def main(argv=None) -> int:
                       churn_interval_ms=args.churn_interval_ms,
                       crash_restart=args.crash_restart,
                       crash_down_ms=args.crash_down_ms,
-                      device_chaos=args.device_chaos)
+                      device_chaos=args.device_chaos,
+                      device_messages=args.device_messages)
         try:
             if config_factory is not None:
                 kwargs["config"] = config_factory()

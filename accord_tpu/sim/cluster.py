@@ -42,7 +42,9 @@ class ClusterConfig:
                  cmd_plane_authoritative: bool = False,
                  store_delays: bool = False, store_delay_max_us: int = 2000,
                  clock_drift: bool = False, clock_offset_max_us: int = 100_000,
-                 clock_drift_max_ppm: int = 10_000):
+                 clock_drift_max_ppm: int = 10_000,
+                 device_messages: bool = False, link_matrix=None,
+                 mailbox_depth: int = 64, mailbox_words: int = 384):
         self.num_nodes = num_nodes
         self.rf = min(rf, num_nodes)
         self.num_shards = num_shards
@@ -101,6 +103,16 @@ class ClusterConfig:
         self.clock_drift = clock_drift
         self.clock_offset_max_us = clock_offset_max_us
         self.clock_drift_max_ppm = clock_drift_max_ppm
+        # device message plane (sim/network.DeviceMessageNetwork +
+        # ops/mailbox.py): batched ticketed delivery with payload bytes
+        # riding the fused protocol_tick's mailbox stage. False = one host
+        # event per message (the bit-identical differential baseline)
+        self.device_messages = device_messages
+        # optional sim/network.LinkMatrix applied at construction (both
+        # modes draw from the same per-link dict it installs)
+        self.link_matrix = link_matrix
+        self.mailbox_depth = mailbox_depth
+        self.mailbox_words = mailbox_words
 
 
 def build_topology(cfg: ClusterConfig, epoch: int = 1) -> Topology:
@@ -224,9 +236,20 @@ class Cluster:
         # run-scoped)
         from accord_tpu.obs.trace import REC
         REC.clock = lambda q=self.queue: q.now_micros
-        self.network = SimNetwork(self.queue, self.rng.fork(),
-                                  timeout_ms=self.config.timeout_ms,
-                                  serialize=self.config.serialize)
+        if self.config.device_messages:
+            from accord_tpu.sim.network import DeviceMessageNetwork
+            self.network = DeviceMessageNetwork(
+                self.queue, self.rng.fork(),
+                timeout_ms=self.config.timeout_ms,
+                serialize=self.config.serialize,
+                link_matrix=self.config.link_matrix,
+                mailbox_depth=self.config.mailbox_depth,
+                mailbox_words=self.config.mailbox_words)
+        else:
+            self.network = SimNetwork(self.queue, self.rng.fork(),
+                                      timeout_ms=self.config.timeout_ms,
+                                      serialize=self.config.serialize,
+                                      link_matrix=self.config.link_matrix)
         self.scheduler = SimScheduler(self.queue)
         self.time_service = SimTimeService(self.queue)
         self.topology = build_topology(self.config)

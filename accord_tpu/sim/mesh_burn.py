@@ -75,13 +75,22 @@ class ClusterTickEngine:
     nodes are skipped at fire time via their scheduler's alive cell, which
     is exactly the baseline's NodeScheduler-guard semantics."""
 
-    def __init__(self, mesh_tick: bool = True, megakernel: bool = False):
+    def __init__(self, mesh_tick: bool = True, megakernel: bool = False,
+                 device_messages: bool = False):
         self.mesh_tick = mesh_tick
         # megakernel rides the mesh_tick staging (it consumes the same
         # recorded plan args); cmd spans defer to the host twin so their
         # transition lanes can join the fused program's quorum stage
         self.megakernel = megakernel and mesh_tick
         self.cmd_defer = self.megakernel
+        # device message plane: replica payloads ride the mailbox routing
+        # stage of the same fused program (requires the megakernel; the
+        # DeviceMessageNetwork batches deliveries either way)
+        self.device_messages = device_messages and self.megakernel
+        self._net = None               # DeviceMessageNetwork once discovered
+        # planes with deferred twin spans whose flush debt should fold into
+        # the next fused tick as repair scatters: id -> [plane, span_count]
+        self._defer_spans: Dict[int, list] = {}
         # fast-path electorate majority for the in-kernel quorum count
         # (run_mesh_burn sets it from rf)
         self.quorum_size = 1
@@ -153,6 +162,32 @@ class ClusterTickEngine:
         decided): stacked into the next fused tick's quorum stage."""
         self._cmd_lanes.append((q_txn, q_ts, q_code))
 
+    def note_cmd_defer(self, plane) -> None:
+        """Device-messages mode: a deferred twin span ran on `plane`; its
+        shadow-write flush debt should retire inside the next fused tick
+        (collect_repair) instead of a standalone flush dispatch."""
+        ent = self._defer_spans.get(id(plane))
+        if ent is None:
+            self._defer_spans[id(plane)] = [plane, 1]
+        else:
+            ent[1] += 1
+
+    def _collect_cmd_repairs(self):
+        """Repair blocks for every plane that deferred since the last fused
+        tick. Planes whose arena is not live (None) keep their debt for the
+        ordinary lazy _flush; planes already clean (an interleaved flush
+        repaired them) fold nothing."""
+        pending, self._defer_spans = self._defer_spans, {}
+        blocks, adopts = [], []
+        for plane, spans in pending.values():
+            rep = plane.collect_repair()
+            if rep is None or rep == "clean":
+                continue
+            block, meta = rep
+            blocks.append(block)
+            adopts.append((plane, meta, spans))
+        return blocks, adopts
+
     def _drain_quorum(self) -> None:
         """Count fast-path quorum txns from completed fused ticks: the
         device `met` lane is read back lazily (here, a tick later or at
@@ -170,6 +205,12 @@ class ClusterTickEngine:
         per (resolver, node); the first note after an idle period arms the
         cluster tick at that node's effective window."""
         self._queue = node.scheduler.queue
+        if self.device_messages and self._net is None:
+            net = getattr(getattr(node, "message_sink", None),
+                          "network", None)
+            if net is not None and hasattr(net, "attach_engine"):
+                net.attach_engine(self)
+                self._net = net
         key = (id(resolver), id(node))
         if key not in self._pending:
             self._pending[key] = (resolver, node)
@@ -420,11 +461,28 @@ class ClusterTickEngine:
             quorum = (jnp.asarray(pt), jnp.asarray(ps), jnp.asarray(pc),
                       jnp.asarray(pv))
             q_txn_np = q_txn
-        if km is not None or rm is not None or fins or quorum is not None:
-            packed_out, rng_out, fin_outs, _cmd, q_out = protocol_tick(
+        # device message plane: stage this tick's in-flight replica traffic
+        # into the mailbox emit lanes, and fold the deferred cmd twins'
+        # flush debt in as repair scatters -- both ride the same single
+        # fused program
+        mail = None
+        if self.device_messages and self._net is not None:
+            mail = self._net.mailbox_flush()
+        rep_blocks, rep_adopts = ((), ())
+        if self.device_messages:
+            rep_blocks, rep_adopts = self._collect_cmd_repairs()
+        if km is not None or rm is not None or fins or quorum is not None \
+                or mail is not None or rep_blocks:
+            (packed_out, rng_out, fin_outs, _cmd, q_out, mail_out,
+             rep_outs) = protocol_tick(
                 res0._table, key_in=key_in, rng_in=rng_in,
                 fins=tuple(fins), quorum=quorum,
-                quorum_size=self.quorum_size)
+                quorum_size=self.quorum_size, mailbox=mail,
+                cmd_repairs=rep_blocks)
+            if mail is not None:
+                self._net.mailbox_adopt(mail_out)
+            for (plane, meta, spans), outs in zip(rep_adopts, rep_outs):
+                plane.adopt_repair(outs, meta, spans)
             self.megakernel_dispatches += 1
             self.protocol_launches += 1
             if km is not None or rm is not None:
@@ -479,6 +537,10 @@ def run_mesh_burn(seed: int, ops: int = 500, *, nodes: int = 8,
                   rf: int = 3, num_shards: Optional[int] = None,
                   stores_per_node: int = 2, mesh_tick: bool = True,
                   megakernel: bool = False,
+                  device_messages: bool = False,
+                  link_matrix=None,
+                  mailbox_depth: int = 64, mailbox_words: int = 384,
+                  progress_interval_ms: float = 250.0,
                   key_count: int = 64, concurrency: int = 16,
                   batch_window_ms: float = 2.0,
                   device_latency_ms: float = 4.0,
@@ -502,7 +564,8 @@ def run_mesh_burn(seed: int, ops: int = 500, *, nodes: int = 8,
     from accord_tpu.ops.resolver import BatchDepsResolver
 
     eng = engine or ClusterTickEngine(mesh_tick=mesh_tick,
-                                      megakernel=megakernel)
+                                      megakernel=megakernel,
+                                      device_messages=device_messages)
     eng.quorum_size = min(rf, nodes) // 2 + 1
     rkw = dict(resolver_kwargs or {})
     rkw.setdefault("num_buckets", num_buckets)
@@ -527,7 +590,11 @@ def run_mesh_burn(seed: int, ops: int = 500, *, nodes: int = 8,
         deps_batch_window_ms=batch_window_ms,
         device_latency_ms=device_latency_ms,
         cmd_plane=cmd_plane,
-        cmd_plane_authoritative=cmd_plane_authoritative)
+        cmd_plane_authoritative=cmd_plane_authoritative,
+        device_messages=device_messages,
+        link_matrix=link_matrix,
+        mailbox_depth=mailbox_depth, mailbox_words=mailbox_words,
+        progress_interval_ms=progress_interval_ms)
     report = run_burn(seed, ops, nodes=nodes, rf=min(rf, nodes),
                       key_count=key_count, concurrency=concurrency,
                       config=cfg, collect_log=collect_log, **burn_kwargs)
@@ -556,6 +623,9 @@ def main(argv=None) -> int:
                     help="per-node launch loop (the differential baseline)")
     ap.add_argument("--megakernel", action="store_true",
                     help="one fused protocol_tick program per cluster tick")
+    ap.add_argument("--device-messages", action="store_true",
+                    help="replica traffic through the device mailbox "
+                         "routing stage (implies --megakernel staging)")
     ap.add_argument("--reconcile", action="store_true",
                     help="run each seed twice; require identical logs")
     args = ap.parse_args(argv)
@@ -572,7 +642,8 @@ def main(argv=None) -> int:
             cmd_plane=args.cmd_plane or args.cmd_plane_authoritative,
             cmd_plane_authoritative=args.cmd_plane_authoritative,
             mesh_tick=not args.python_loop,
-            megakernel=args.megakernel)
+            megakernel=args.megakernel or args.device_messages,
+            device_messages=args.device_messages)
         try:
             r, eng = run_mesh_burn(seed, collect_log=args.reconcile,
                                    **kwargs)

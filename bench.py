@@ -1691,11 +1691,151 @@ def bench_megakernel(quick: bool):
         raise AssertionError(
             f"MULTICHIP megakernel leg ran on {multichip['devices']} devices")
 
+    largest = results[max(results)]
     return {
         "seed": seed,
+        # headline keys (main() grafts messages_per_host_callback from the
+        # message-plane leg next to these)
+        "launches_per_tick": 1.0,    # asserted per size above
+        "wall_committed_per_s": largest["mega_committed_per_s"],
         "sweep": {str(n): r for n, r in results.items()},
         "recompiles_in_sweep": 0,    # asserted above
         "multichip": multichip,
+    }
+
+
+def bench_message_plane(quick: bool):
+    """Device message plane sweep at 64/256/1024 nodes: replica traffic
+    routed through the mailbox arena inside the fused protocol_tick
+    (sim/network.DeviceMessageNetwork + ops/mailbox.py) vs the per-message
+    host event baseline. Hard gates per size: bit-identical committed
+    histories, `launches_per_tick` exactly 1.0 (the mailbox stage rides
+    the one fused launch, it never adds one), zero mailbox overflow spills
+    and zero verify fallbacks in steady state; across the sweep: host
+    message callbacks collapsed >= 10x (`messages_per_host_callback`) and
+    zero compiles minted in the timed window over the full
+    jit_cache_sizes() surface. Two parity side legs ride along gated on
+    history equality only: a chaos leg (drops + partitions) and a 3-region
+    ASYMMETRIC regional-latency LinkMatrix leg that the host path also
+    runs -- one matrix feeding both modes bit-identically."""
+    from accord_tpu.ops.kernels import jit_cache_sizes
+    from accord_tpu.sim.mesh_burn import run_mesh_burn
+    from accord_tpu.sim.network import LinkMatrix
+
+    sizes = (((64, 30), (256, 20), (1024, 8)) if quick else
+             ((64, 60), (256, 30), (1024, 12)))
+    seed = 6
+    # rf=5: the callback-collapse ratio is message density against the
+    # fixed tick cadence, and a wider electorate is the honest way to get
+    # cluster-scale message volume at benchable op counts
+    base = dict(rf=5, concurrency=24, megakernel=True, collect_log=True)
+    chaos_kw = dict(nodes=64, chaos_drop=0.05, chaos_partitions=True,
+                    **base)
+    chaos_ops = 30 if quick else 60
+    regional_kw = dict(nodes=64, link_matrix=LinkMatrix.regional(64),
+                       **base)
+    regional_ops = 30 if quick else 60
+
+    # warm pass: every leg both modes, SAME seed/kwargs as the timed
+    # sweep, so each static signature (mailbox tiers included) compiles
+    # before the snapshot
+    for nodes, ops in sizes:
+        run_mesh_burn(seed, ops, nodes=nodes, device_messages=True, **base)
+        run_mesh_burn(seed, ops, nodes=nodes, **base)
+    run_mesh_burn(seed, chaos_ops, device_messages=True, **chaos_kw)
+    run_mesh_burn(seed, chaos_ops, **chaos_kw)
+    run_mesh_burn(seed, regional_ops, device_messages=True, **regional_kw)
+    run_mesh_burn(seed, regional_ops, **regional_kw)
+    cache0 = jit_cache_sizes()
+
+    results = {}
+    fires = batches = 0
+    for nodes, ops in sizes:
+        t0 = time.perf_counter()
+        dev, deng = run_mesh_burn(seed, ops, nodes=nodes,
+                                  device_messages=True, **base)
+        dev_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        host, _ = run_mesh_burn(seed, ops, nodes=nodes, **base)
+        host_s = time.perf_counter() - t0
+        if dev.log != host.log:
+            raise AssertionError(
+                f"{nodes}-node device-message burn diverged from the host "
+                f"path ({len(dev.log)} vs {len(host.log)} entries)")
+        c = dev.counters
+        if c["launches_per_tick"] != 1.0:
+            raise AssertionError(
+                f"{nodes}-node: mailbox routing cost extra launches "
+                f"({c['launches_per_tick']:.2f} per tick; gate: exactly 1)")
+        if c["mailbox_overflow_spills"] != 0:
+            raise AssertionError(
+                f"{nodes}-node: {c['mailbox_overflow_spills']} mailbox "
+                f"spills in steady state (gate: 0)")
+        if c["mailbox_verify_fallbacks"] != 0:
+            raise AssertionError(
+                f"{nodes}-node: {c['mailbox_verify_fallbacks']} device "
+                f"payloads failed verification (gate: 0)")
+        if c["device_messages_delivered"] <= 0:
+            raise AssertionError(f"{nodes}-node: no device delivery")
+        fires += c["message_plane_fires"]
+        batches += c["message_plane_batches"]
+        results[nodes] = {
+            "ops": ops,
+            "acked": dev.acked,
+            "device_messages_delivered": c["device_messages_delivered"],
+            "mailbox_depth_high_water": c["mailbox_depth_high_water"],
+            "messages_per_host_callback": c["messages_per_host_callback"],
+            "launches_per_tick": c["launches_per_tick"],
+            "dev_committed_per_s": round(dev.acked / max(dev_s, 1e-9), 1),
+            "host_committed_per_s": round(host.acked / max(host_s, 1e-9), 1),
+            "history_identical": True,
+        }
+
+    collapse = fires / max(batches, 1)
+    if collapse < 10.0:
+        raise AssertionError(
+            f"host message callbacks only collapsed {collapse:.1f}x across "
+            f"the sweep (gate: >= 10x)")
+
+    # chaos parity: seeded drops + partitions through the mailbox plane
+    # must not shift any rng stream
+    dev, _ = run_mesh_burn(seed, chaos_ops, device_messages=True,
+                           **chaos_kw)
+    host, _ = run_mesh_burn(seed, chaos_ops, **chaos_kw)
+    if dev.log != host.log:
+        raise AssertionError("chaos leg diverged under device messages")
+    chaos = {"ops": chaos_ops, "history_identical": True,
+             "mailbox_verify_fallbacks":
+                 dev.counters["mailbox_verify_fallbacks"]}
+
+    # regional parity: the 3-region asymmetric matrix runs through BOTH
+    # paths (one LinkMatrix feeds the host dict and the device masks)
+    dev, _ = run_mesh_burn(seed, regional_ops, device_messages=True,
+                           **regional_kw)
+    host, _ = run_mesh_burn(seed, regional_ops, **regional_kw)
+    if dev.log != host.log:
+        raise AssertionError("regional-latency leg diverged between paths")
+    regional = {"ops": regional_ops, "regions": 3,
+                "history_identical_both_paths": True,
+                "messages_per_host_callback":
+                    dev.counters["messages_per_host_callback"]}
+
+    cache1 = jit_cache_sizes()
+    if cache1 != cache0:
+        diff = {k: (cache0.get(k), cache1.get(k))
+                for k in set(cache0) | set(cache1)
+                if cache0.get(k) != cache1.get(k)}
+        raise AssertionError(
+            f"message-plane sweep minted compiles in the timed window: "
+            f"{diff}")
+
+    return {
+        "seed": seed,
+        "messages_per_host_callback": round(collapse, 2),
+        "sweep": {str(n): r for n, r in results.items()},
+        "chaos": chaos,
+        "regional": regional,
+        "recompiles_in_sweep": 0,    # asserted above
     }
 
 
@@ -1822,6 +1962,10 @@ def main(argv=None) -> int:
         cmd_plane = _traced("cmd_plane", bench_cmd_plane, args.quick)
         mesh_burn = _traced("mesh_burn", bench_mesh_burn, args.quick)
         megakernel = _traced("megakernel", bench_megakernel, args.quick)
+        message_plane = _traced("message_plane", bench_message_plane,
+                                args.quick)
+        megakernel["messages_per_host_callback"] = \
+            message_plane["messages_per_host_callback"]
         # subprocess leg last: it runs in its OWN processes (each does its
         # own warmup), so the parent's jit caches and trace are untouched
         serve = bench_serve(args.quick)
@@ -1845,6 +1989,7 @@ def main(argv=None) -> int:
                 "cmd_plane": cmd_plane,
                 "mesh_burn": mesh_burn,
                 "megakernel": megakernel,
+                "message_plane": message_plane,
                 "serve": serve,
                 "obs_overhead": obs_overhead,
             },

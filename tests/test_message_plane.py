@@ -1,0 +1,286 @@
+"""Device message plane tests: the ticketed batched drain + device mailbox
+routing (sim/network.DeviceMessageNetwork + ops/mailbox.py) against the
+per-message host event baseline.
+
+The contract under test is EXACT equivalence, not statistical agreement:
+both modes consume the same rng draws and queue sequence numbers at the
+same call sites, so a burn's committed event log must be bit-identical
+with `device_messages=True` -- including under chaos (drops, partitions,
+crash/restart) and range traffic. The fast subset here rides tier 1.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from accord_tpu.ops.mailbox import MailboxPlane, pack_words, unpack_words
+from accord_tpu.sim.burn import run_burn
+from accord_tpu.sim.mesh_burn import run_mesh_burn
+from accord_tpu.sim.network import (DeviceMessageNetwork, LinkConfig,
+                                    LinkMatrix, SimNetwork)
+from accord_tpu.sim.queue import PendingQueue
+from accord_tpu.utils.rng import RandomSource
+
+pytestmark = pytest.mark.message_plane
+
+
+# -- queue ticket primitives --------------------------------------------------
+
+def test_queue_tickets_share_event_sequence():
+    """ticket() consumes the same counter add() stamps onto events, so a
+    ticketed message occupies exactly the heap position the baseline's
+    deliver event would have."""
+    q = PendingQueue()
+    fired = []
+    q.add(10, lambda: fired.append("a"))    # seq 0
+    t = q.ticket()                          # seq 1 -- the parked message
+    q.add(10, lambda: fired.append("c"))    # seq 2
+    q.add_ticketed_at(q.now_micros + 10, t, lambda: fired.append("b"))
+    q.drain()
+    assert fired == ["a", "b", "c"]
+
+
+def test_queue_peek_skips_cancelled_heads():
+    q = PendingQueue()
+    t0 = q.now_micros
+    h = q.add(5, lambda: None)
+    q.add(9, lambda: None)
+    assert q.peek() == (t0 + 5, 0)
+    h.cancel()
+    assert q.peek() == (t0 + 9, 1)
+    q.drain()
+    assert q.peek() is None
+
+
+# -- payload packing ----------------------------------------------------------
+
+def test_pack_unpack_roundtrip():
+    for n in (0, 1, 3, 4, 5, 63, 64, 251, 252):
+        payload = bytes(range(256))[:n] * 1
+        w = pack_words(payload, 64)
+        assert w is not None and w.shape == (64,)
+        assert unpack_words(w) == payload
+    # exactly full: 4*(width-1) bytes fit, one more spills
+    assert pack_words(b"x" * 252, 64) is not None
+    assert pack_words(b"x" * 253, 64) is None
+
+
+# -- link matrix --------------------------------------------------------------
+
+def test_link_matrix_regional_asymmetry():
+    """Eastward cross-region links are scaled slower than their westward
+    twins; intra-region links are symmetric."""
+    m = LinkMatrix.regional(12, regions=3, asymmetry=0.5)
+    east = m.config(1, 12)   # region 0 -> region 2
+    west = m.config(12, 1)   # region 2 -> region 0
+    assert east.min_latency_us > west.min_latency_us
+    assert east.max_latency_us > west.max_latency_us
+    a, b = m.config(1, 2), m.config(2, 1)  # same region
+    assert (a.min_latency_us, a.max_latency_us) == \
+        (b.min_latency_us, b.max_latency_us)
+
+
+def test_link_matrix_latency_draws_within_bounds():
+    """A network seeded from a LinkMatrix draws every latency inside that
+    directed link's [min, max] band -- the same dict feeds both modes."""
+    m = LinkMatrix(4)
+    m.set(1, 2, LinkConfig(100, 200))
+    m.set(2, 1, LinkConfig(5_000, 9_000))
+    net = SimNetwork(PendingQueue(), RandomSource(3), link_matrix=m)
+    for _ in range(50):
+        assert 100 <= net._latency(1, 2) <= 200
+        assert 5_000 <= net._latency(2, 1) <= 9_000
+
+
+# -- unit-level network behaviour --------------------------------------------
+
+class _StubNode:
+    def __init__(self, nid):
+        self.id = nid
+        self.got = []
+
+    def receive(self, msg, src, ctx):
+        self.got.append((msg, src))
+
+
+def _pair(net_cls, seed=7, **kw):
+    q = PendingQueue()
+    net = net_cls(q, RandomSource(seed), serialize=False, **kw)
+    a, b = _StubNode(1), _StubNode(2)
+    net.register_node(a)
+    net.register_node(b)
+    return q, net, a, b
+
+
+def test_device_network_delivery_order_matches_host():
+    """Same seed, same sends: the batched ticketed drain delivers in the
+    baseline's exact order and the stats dicts agree."""
+    results = []
+    for cls in (SimNetwork, DeviceMessageNetwork):
+        q, net, a, b = _pair(cls)
+        for i in range(40):
+            net.send_request(1 if i % 3 else 2, 2 if i % 3 else 1, i, None)
+        q.drain()
+        results.append((list(b.got), list(a.got), dict(net.stats)))
+    assert results[0] == results[1]
+
+
+def test_drop_accounting_matches_host():
+    for cls in (SimNetwork, DeviceMessageNetwork):
+        q, net, a, b = _pair(cls)
+        net.set_link(1, 2, LinkConfig(100, 200, drop_probability=1.0))
+        for i in range(10):
+            net.send_request(1, 2, i, None)
+            net.send_request(2, 1, i, None)
+        q.drain()
+        assert net.stats["dropped"] == 10
+        assert net.stats["delivered"] == 10
+        assert b.got == []
+        assert len(a.got) == 10
+
+
+def test_partition_symmetry():
+    """set_partitioned cuts BOTH directions of the pair, and healing
+    restores them; the device twin behaves identically."""
+    for cls in (SimNetwork, DeviceMessageNetwork):
+        q, net, a, b = _pair(cls)
+        net.set_partitioned(1, 2, True)
+        net.send_request(1, 2, "x", None)
+        net.send_request(2, 1, "y", None)
+        q.drain()
+        assert a.got == [] and b.got == []
+        net.set_partitioned(1, 2, False)
+        net.send_request(1, 2, "x", None)
+        net.send_request(2, 1, "y", None)
+        q.drain()
+        assert len(a.got) == 1 and len(b.got) == 1
+
+
+def test_mailbox_partition_mask_symmetric():
+    plane = MailboxPlane(4, depth=4, words=8)
+    plane.set_partitions({frozenset((1, 3))}, version=1)
+    part = np.asarray(plane.part)
+    assert bool(part[1, 3]) and bool(part[3, 1])
+    assert not part[1, 2] and not part[2, 4]
+    assert plane.counters()["mailbox_partition_epochs"] == 1
+
+
+# -- burn differentials (engine-less batched drain) ---------------------------
+
+def test_burn_differential_batched_drain():
+    """Host vs device-messages burn (no tick engine attached): identical
+    committed logs, and the drain collapsed many deliveries per host
+    callback."""
+    kw = dict(ops=60, nodes=3, concurrency=4, collect_log=True)
+    host = run_burn(7, **kw)
+    dev = run_burn(7, device_messages=True, **kw)
+    assert host.log == dev.log
+    assert dev.counters["message_plane_batches"] > 0
+    assert dev.counters["messages_per_host_callback"] > 2.0
+    assert dev.counters["mailbox_verify_fallbacks"] == 0
+    assert "message_plane_batches" not in host.counters
+
+
+def test_burn_differential_range_traffic_and_chaos():
+    """Range reads/writes + drop chaos + partitions: the rng streams stay
+    aligned, so the histories match bit for bit."""
+    kw = dict(ops=50, nodes=3, concurrency=4, collect_log=True,
+              chaos_drop=0.08, chaos_partitions=True,
+              range_read_ratio=0.2, range_write_ratio=0.1)
+    host = run_burn(13, **kw)
+    dev = run_burn(13, device_messages=True, **kw)
+    assert host.log == dev.log
+
+
+def test_burn_device_messages_reconcile():
+    """Device-messages mode reconciles with itself: same seed twice gives
+    the same log (the --reconcile CLI contract)."""
+    kw = dict(ops=50, nodes=3, concurrency=4, collect_log=True,
+              device_messages=True)
+    assert run_burn(19, **kw).log == run_burn(19, **kw).log
+
+
+# -- fused mailbox routing (tick engine attached) -----------------------------
+
+def test_megakernel_device_messages_differential():
+    """The full tentpole path: payload bytes ride the mailbox arena inside
+    the single fused protocol_tick launch, every delivery verifies against
+    the staged host bytes, and the committed history is bit-identical to
+    the host-message megakernel run."""
+    kw = dict(ops=40, nodes=3, megakernel=True, collect_log=True)
+    host, _ = run_mesh_burn(5, **kw)
+    dev, eng = run_mesh_burn(5, device_messages=True, **kw)
+    assert host.log == dev.log
+    c = dev.counters
+    assert c["device_messages_delivered"] > 0
+    assert c["mailbox_verify_fallbacks"] == 0
+    assert c["mailbox_overflow_spills"] == 0
+    assert c["launches_per_tick"] == 1.0
+    assert c["messages_per_host_callback"] > 2.0
+
+
+@pytest.mark.slow
+def test_megakernel_device_messages_chaos_seeds():
+    """Chaos legs at 4 nodes: drops + partitions + crash/restart, two
+    seeds. Device mailbox routing must not disturb any rng stream."""
+    kw = dict(ops=70, nodes=4, megakernel=True, collect_log=True,
+              chaos_drop=0.05, chaos_partitions=True, crash_restart=True)
+    for seed in (23, 31):
+        host, _ = run_mesh_burn(seed, **kw)
+        dev, _ = run_mesh_burn(seed, device_messages=True, **kw)
+        assert host.log == dev.log, f"chaos diverged at seed {seed}"
+        assert dev.counters["mailbox_verify_fallbacks"] == 0
+
+
+@pytest.mark.slow
+def test_cmd_defer_retired_rides_fused_program():
+    """Satellite 1: with the command plane on, host-twinned PreAccept
+    deferrals are folded back through the fused program's repair stage and
+    counted retired -- without disturbing the committed history."""
+    kw = dict(ops=60, nodes=3, megakernel=True, cmd_plane=True,
+              collect_log=True)
+    host, _ = run_mesh_burn(9, **kw)
+    dev, _ = run_mesh_burn(9, device_messages=True, **kw)
+    assert host.log == dev.log
+    assert dev.counters.get("cmd_defer_retired", 0) > 0
+
+
+@pytest.mark.slow
+def test_seeded_mailbox_corruption_caught_by_verify():
+    """Seeded device-routing bit flips (fault_plane mailbox_rate): every
+    injection is caught by the verify-against-staged-bytes contract and
+    falls back to the host copy, so the chaos history still matches the
+    fault-free host run bit for bit."""
+    kw = dict(ops=40, nodes=3, megakernel=True, collect_log=True)
+    host, _ = run_mesh_burn(5, **kw)
+    dev, _ = run_mesh_burn(5, device_messages=True, device_chaos=True,
+                           device_fault_rates={"mailbox_rate": 0.25}, **kw)
+    assert host.log == dev.log
+    injected = dev.device_faults["mailbox"]
+    assert injected > 0, "mailbox fault rate 0.25 never drew"
+    assert dev.counters["mailbox_verify_fallbacks"] == injected
+    assert dev.counters["device_messages_delivered"] > 0
+
+
+@pytest.mark.slow
+def test_tiny_mailbox_overflow_degrades_gracefully():
+    """Satellite 6: a mailbox far too small for the traffic spills to the
+    host path (counted), and the history still matches the host run."""
+    kw = dict(ops=40, nodes=3, megakernel=True, collect_log=True)
+    host, _ = run_mesh_burn(5, **kw)
+    dev, _ = run_mesh_burn(5, device_messages=True,
+                           mailbox_depth=2, mailbox_words=16, **kw)
+    assert host.log == dev.log
+    assert dev.counters["mailbox_overflow_spills"] > 0
+
+
+@pytest.mark.slow
+def test_regional_link_matrix_both_paths():
+    """The 3-region asymmetric matrix runs bit-identically through the
+    host event queue and the device plane (the bench parity leg)."""
+    m = LinkMatrix.regional(6, regions=3)
+    kw = dict(ops=50, nodes=6, megakernel=True, collect_log=True,
+              link_matrix=m)
+    host, _ = run_mesh_burn(17, **kw)
+    dev, _ = run_mesh_burn(17, device_messages=True, **kw)
+    assert host.log == dev.log
