@@ -409,6 +409,7 @@ GLOSSARY: Dict[str, str] = {
     "megakernel_dispatches": "cluster ticks launched as one fused protocol_tick program",
     "launches_per_tick": "mean device program launches per cluster tick that dispatched",
     "fastpath_quorum_txns": "distinct txns whose PreAccept lanes met the in-kernel fast-path quorum",
+    "sharded_megakernel_fallbacks": "megakernel ticks on a mesh that fell back to the unfused sharded pair",
     # -- device message plane (sim/network.DeviceMessageNetwork
     #    .message_plane_snapshot(), folded into the burn report's counters) ---
     "device_messages_delivered": "deliveries whose payload came from the device mailbox (verified)",

@@ -1351,21 +1351,7 @@ def protocol_tick(witness_table, key_in=None, rng_in=None, fins=(),
     -> (packed, (rpacked, kpacked), fin_outs, cmd_outs,
         (fast, votes, met), mail_out, rep_outs); absent stages return ().
     """
-    fin_statics, fin_traced = [], []
-    for f in fins:
-        if f[0] == "range":
-            fin_statics.append(("range", f[8]))
-            fin_traced.append(tuple(f[1:8]))
-        else:
-            fin_statics.append((f[0], f[3], f[4], f[11]))
-            fin_traced.append((f[1], f[2]) + tuple(f[5:11]))
-    # canonicalize: stable-sort the finalize specs by static signature so
-    # the compiled-program key depends on the tick's signature MULTISET,
-    # not the arrival order of plans -- order jitter across ticks would
-    # otherwise mint a fresh multi-second compile per permutation
-    order = sorted(range(len(fin_statics)), key=lambda i: fin_statics[i])
-    fin_statics = [fin_statics[i] for i in order]
-    fin_traced = [fin_traced[i] for i in order]
+    fin_statics, fin_traced, order = _fin_split(fins)
     cmd_statics = tuple(bool(c[-1]) for c in cmds)
     cmd_traced = tuple(tuple(c[:-1]) for c in cmds)
     statics = (key_in is not None, rng_in is not None, tuple(fin_statics),
@@ -1380,13 +1366,41 @@ def protocol_tick(witness_table, key_in=None, rng_in=None, fins=(),
         tuple(quorum) if quorum is not None else (),
         tuple(mailbox) if mailbox is not None else (),
         tuple(tuple(r) for r in cmd_repairs))
-    if order != list(range(len(order))):
-        # undo the canonical sort: callers demux fin_outs positionally
-        back = [0] * len(order)
-        for pos, i in enumerate(order):
-            back[i] = pos
-        fin_outs = tuple(fin_outs[back[i]] for i in range(len(order)))
-    return packed, rng_out, fin_outs, cmd_outs, q_out, mail_out, rep_outs
+    return (packed, rng_out, _fin_unsort(fin_outs, order), cmd_outs,
+            q_out, mail_out, rep_outs)
+
+
+def _fin_split(fins):
+    """Split finalize specs into (static signature, traced args) and
+    canonically stable-sort them by static signature, so the compiled
+    program key depends on the tick's signature MULTISET, not the arrival
+    order of plans -- order jitter across ticks would otherwise mint a
+    fresh multi-second compile per permutation. Shared by protocol_tick
+    and parallel.mesh.sharded_protocol_tick (same cache-key discipline on
+    both paths). Returns (fin_statics, fin_traced, order); undo the sort
+    on the outputs with _fin_unsort(fin_outs, order)."""
+    fin_statics, fin_traced = [], []
+    for f in fins:
+        if f[0] == "range":
+            fin_statics.append(("range", f[8]))
+            fin_traced.append(tuple(f[1:8]))
+        else:
+            fin_statics.append((f[0], f[3], f[4], f[11]))
+            fin_traced.append((f[1], f[2]) + tuple(f[5:11]))
+    order = sorted(range(len(fin_statics)), key=lambda i: fin_statics[i])
+    return ([fin_statics[i] for i in order],
+            [fin_traced[i] for i in order], order)
+
+
+def _fin_unsort(fin_outs, order):
+    """Undo _fin_split's canonical sort: callers demux fin_outs
+    positionally against the fins they passed in."""
+    if order == list(range(len(order))):
+        return tuple(fin_outs)
+    back = [0] * len(order)
+    for pos, i in enumerate(order):
+        back[i] = pos
+    return tuple(fin_outs[back[i]] for i in range(len(order)))
 
 
 def protocol_tick_cache_sizes() -> int:
@@ -1417,6 +1431,8 @@ def jit_cache_sizes() -> dict:
         # node-lane (cluster-on-mesh burn) kernels live in ops/node_lane,
         # which imports from this module -- resolve lazily to avoid a cycle
         **_node_lane_cache_sizes(),
+        # likewise the sharded megakernel lives in parallel/mesh
+        **_mesh_cache_sizes(),
     }
 
 
@@ -1430,3 +1446,11 @@ def _node_lane_cache_sizes() -> dict:
                 "node_fused_range_deps_resolve": 0,
                 "lane_slice": 0}
     return mod.node_lane_cache_sizes()
+
+
+def _mesh_cache_sizes() -> dict:
+    import sys
+    mod = sys.modules.get("accord_tpu.parallel.mesh")
+    if mod is None:
+        return {"sharded_protocol_tick": 0}
+    return {"sharded_protocol_tick": mod.sharded_protocol_tick_cache_sizes()}

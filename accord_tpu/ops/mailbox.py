@@ -27,11 +27,23 @@ Overflow is graceful by design: an emit whose payload exceeds the slot width
 or whose destination ring is full simply keeps its host bytes and bumps
 `mailbox_overflow_spills`; the bench steady-state gate asserts that counter
 stays zero at tuned depths.
+
+Sharded meshes (`shards > 1`): the node lanes pad up so shard boundaries
+fall on node boundaries (node v lives on shard v // npsh), the arena and
+the partition mask both shard node-major over the mesh's 'data' axis, and
+emit lanes stage GROUPED by (src shard, dst shard) -- segment (s, t) of
+the flat lane arrays holds the lanes shard s emits toward shard t, so the
+fused routing stage's `lax.all_to_all` over 'data' delivers every
+cross-shard payload into its destination shard's rings in one collective
+(`_sharded_mailbox_route_part`, composed into the sharded protocol
+megakernel by parallel/mesh.sharded_protocol_tick). shards == 1 degrades
+to the exact single-device layout bit for bit.
 """
 from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -85,6 +97,53 @@ def _mailbox_route_body(arena, meta, e_src, e_dst, e_slot, e_keep,
     return arena, meta, arena[back], meta[back], land
 
 
+def _sharded_mailbox_route_part(shards, axis, arena_l, meta_l, e_src, e_dst,
+                                e_slot, e_keep, e_kind, e_seq, e_words,
+                                part_l):
+    """Per-shard body of the cross-shard mailbox routing stage, run inside
+    a shard_map over the mesh's `axis` ('data') by the sharded protocol
+    megakernel.
+
+    arena_l  i32[npsh*depth, words]  THIS shard's node rings (node-major)
+    meta_l   i32[npsh*depth, 3]      (src, kind, seq) per local slot
+    part_l   bool[npsh, rows_nodes]  partition rows for this shard's nodes
+    e_*      this shard's SRC-grouped emit lanes, flat [shards*bcap]:
+             segment t holds the lanes destined to shard t (keep=False pads)
+
+    The land decision runs on the SOURCE shard (it owns the partition-mask
+    rows for its src nodes), then every lane field -- land flag and payload
+    words included -- rides one tiled `lax.all_to_all` over `axis`: segment
+    t of each source shard's lanes lands as segment s on destination shard
+    t, so after the exchange this shard holds exactly the lanes addressed
+    to ITS rings, in (src shard, stage order) order. The local scatter and
+    the verify gather-back then mirror _mailbox_route_body on the local row
+    frame; the returned landed block stacks receiver-major, giving the
+    host's per-entry return position (dst_shard*shards + src_shard)*bcap+j.
+    shards == 1 is the degenerate identity exchange: same scatter, same
+    landed order as the single-device body."""
+    rows_l = arena_l.shape[0]
+    npsh = part_l.shape[0]
+    depth = rows_l // npsh
+    d = jax.lax.axis_index(axis)
+    src_loc = jnp.clip(e_src - d * npsh, 0, npsh - 1)
+    land = e_keep & ~part_l[src_loc, e_dst]
+
+    def xch(x):
+        return jax.lax.all_to_all(x, axis, 0, 0, tiled=True)
+
+    r_src, r_dst, r_slot = xch(e_src), xch(e_dst), xch(e_slot)
+    r_kind, r_seq = xch(e_kind), xch(e_seq)
+    r_words, r_land = xch(e_words), xch(land)
+    dst_loc = r_dst - d * npsh
+    flat = jnp.where(r_land & (dst_loc >= 0) & (dst_loc < npsh),
+                     dst_loc * depth + r_slot, rows_l)
+    arena_l = arena_l.at[flat].set(r_words, mode="drop")
+    meta_l = meta_l.at[flat].set(
+        jnp.stack([r_src, r_kind, r_seq], axis=1), mode="drop")
+    back = jnp.minimum(flat, rows_l - 1)
+    return arena_l, meta_l, arena_l[back], meta_l[back], r_land
+
+
 class _Batch:
     """One flush's worth of landed device outputs, materialized host-side
     lazily (one transfer per launch, not per message). Entries reference
@@ -103,10 +162,17 @@ class MailboxPlane:
     destination ring, emit-lane staging, partition-mask epochs, and the
     verify-on-read landing buffers."""
 
-    def __init__(self, num_nodes: int, depth: int = 64, words: int = 384):
+    def __init__(self, num_nodes: int, depth: int = 64, words: int = 384,
+                 shards: int = 1):
         self.n = int(num_nodes)
         self.depth = int(depth)
         self.words = int(words)
+        # shards > 1: pad the node-lane count so shard boundaries fall on
+        # node boundaries (node v -> shard v // npsh); shards == 1 keeps
+        # rows_nodes == n + 1, the exact single-device layout
+        self.shards = max(int(shards), 1)
+        self.npsh = -(-(self.n + 1) // self.shards)
+        self.rows_nodes = self.npsh * self.shards
         self.arena = None       # device arrays, created on first stage
         self.meta = None
         self.part = None        # device partition mask for current epoch
@@ -122,7 +188,7 @@ class MailboxPlane:
 
     # -- epoch config --------------------------------------------------------
     def set_partitions(self, partitioned, version: int) -> None:
-        mask = np.zeros((self.n + 1, self.n + 1), bool)
+        mask = np.zeros((self.rows_nodes, self.rows_nodes), bool)
         for pair in partitioned:
             a, b = tuple(pair)
             mask[a, b] = mask[b, a] = True
@@ -154,12 +220,23 @@ class MailboxPlane:
         if not staged:
             return None
         if self.arena is None:
-            rows = (self.n + 1) * self.depth
+            rows = self.rows_nodes * self.depth
             self.arena = jnp.zeros((rows, self.words), jnp.int32)
             self.meta = jnp.zeros((rows, 3), jnp.int32)
         if self.part is None:
             self.set_partitions((), self.link_version or 0)
-        cap = mega_lane_tier(len(staged))
+        # lanes stage grouped by (src shard, dst shard): segment (s, t) of
+        # the flat arrays holds shard s's emits toward shard t, so the
+        # sharded route's all_to_all delivers each segment whole. With
+        # shards == 1 there is one group and this is exactly the old flat
+        # staging-order layout.
+        S, npsh = self.shards, self.npsh
+        groups: Dict[tuple, list] = {}
+        for ent in staged:
+            e = ent[0]
+            groups.setdefault((e.src // npsh, e.dst // npsh), []).append(ent)
+        bcap = mega_lane_tier(max(len(g) for g in groups.values()))
+        cap = S * S * bcap
         e_src = np.zeros(cap, np.int32)
         e_dst = np.zeros(cap, np.int32)
         e_slot = np.zeros(cap, np.int32)
@@ -168,16 +245,20 @@ class MailboxPlane:
         e_seq = np.zeros(cap, np.int32)
         e_words = np.zeros((cap, self.words), np.int32)
         batch = _Batch()
-        for pos, (e, idx, w) in enumerate(staged):
-            e.slot = (batch, pos, e.dst, idx)
-            e_src[pos] = e.src
-            e_dst[pos] = e.dst
-            e_slot[pos] = idx
-            e_keep[pos] = True
-            e_kind[pos] = e.kind
-            e_seq[pos] = e.ticket & 0x7FFFFFFF
-            e_words[pos] = w
-            self.c["mailbox_bytes_staged"] += len(e.payload)
+        for (s, t), ents in groups.items():
+            for j, (e, idx, w) in enumerate(ents):
+                pos = (s * S + t) * bcap + j
+                # the landed block comes back receiver-major (identity for
+                # shards == 1): the entry's return position swaps s and t
+                e.slot = (batch, (t * S + s) * bcap + j, e.dst, idx)
+                e_src[pos] = e.src
+                e_dst[pos] = e.dst
+                e_slot[pos] = idx
+                e_keep[pos] = True
+                e_kind[pos] = e.kind
+                e_seq[pos] = e.ticket & 0x7FFFFFFF
+                e_words[pos] = w
+                self.c["mailbox_bytes_staged"] += len(e.payload)
         self._launched = batch
         return (self.arena, self.meta, e_src, e_dst, e_slot, e_keep,
                 e_kind, e_seq, e_words, self.part)

@@ -36,6 +36,13 @@ arena blocks under slot -1 -- node-count churn (crashes, membership change)
 re-lands on the same compiled tiers, so steady-state burns mint zero new
 jit entries (asserted by bench_mesh_burn via kernels.jit_cache_sizes and
 the node-lane cache sizes below).
+
+The merge structures built here (build_key_merge / build_range_merge) are
+consumed by THREE launch paths, all bit-identical by the argument above:
+the single-device fused kernels, ops/kernels.protocol_tick (the
+single-device megakernel inlines _key_resolve_body/_range_resolve_body),
+and parallel/mesh.sharded_protocol_tick (the sharded megakernel feeds the
+same merge inputs to its shard_map'd resolve stage).
 """
 from __future__ import annotations
 

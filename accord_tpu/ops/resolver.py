@@ -3874,7 +3874,13 @@ class ShardedBatchDepsResolver(BatchDepsResolver):
     (the arena keeps holding the single-device arrays its scatters produce).
     On a virtual CPU mesh that cost is noise; a real multi-chip deployment
     would additionally give the scatter/grow ops matching out_shardings so
-    the arrays LIVE sharded and the per-call movement is dirty rows only."""
+    the arrays LIVE sharded and the per-call movement is dirty rows only.
+
+    With a ClusterTickEngine attached in megakernel mode, the recorded
+    plan args (the shared staging code records them whenever tick_driver
+    is set) launch through parallel/mesh.sharded_protocol_tick instead of
+    the unfused sharded pair: one fused mesh program per cluster tick,
+    warmed by parallel.mesh.warmup_sharded's mega_quorum_sizes tiers."""
 
     def __init__(self, mesh=None, num_buckets: int = 256,
                  initial_cap: int = 4096, fuse_cross_store: bool = True,

@@ -30,10 +30,20 @@ to the single-device kernel's. The interval-stab finalize
 (range_finalize_csr) stays a plain jit: the range arena is tiny (tens of
 rows) and carries no word-packed matrix worth sharding.
 
-The protocol megakernel (ops/kernels.protocol_tick) is single-device by
-design: sharded clusters keep this module's unfused <=2-dispatch pair
-(sharded_node_tick) -- fusing finalize + quorum stages into the shard_map
-program is the open scale-out follow-up (see ROADMAP).
+The sharded protocol megakernel (sharded_protocol_tick) is the multi-chip
+twin of ops/kernels.protocol_tick: ONE jitted mesh program per cluster
+tick composing the shard_map'd node-lane key+range resolve, every plan's
+finalize-CSR compaction (the _sharded_finalize_body popcount/prefix +
+all_gather merge above, sliced at each plan's merge span in-program),
+cmd_tick blocks, the fast-path electorate-quorum count, and the
+cross-shard mailbox routing stage -- emit lanes whose dst node lives on
+another shard ride a tiled lax.all_to_all over 'data' into the
+destination shard's rings (ops/mailbox._sharded_mailbox_route_part), with
+partition masks and the mailbox arena sharded node-major. Finalize specs
+canonically sort by static signature (kernels._fin_split), so the compile
+cache keys on the tick-signature multiset exactly as the single-device
+path does. sharded_node_tick (the unfused <=2-dispatch pair) stays live
+as the megakernel=False baseline.
 """
 from __future__ import annotations
 
@@ -65,14 +75,15 @@ def make_mesh(n_devices: Optional[int] = None,
 def mesh_supports_message_plane(mesh: Mesh) -> bool:
     """Whether the device mailbox plane may fuse into sharded programs.
 
-    The mailbox scatter stage (ops/mailbox._mailbox_route_body) assumes a
-    replicated arena: every emit lane may target any destination row, so a
-    'data'-sharded arena would need a cross-shard permute collective that the
-    single-dispatch protocol_tick deliberately does not carry. Until that
-    collective exists, sharded runs keep replica traffic on the host path and
-    only single-mesh (or replicated) programs ride the device plane.
-    """
-    return False
+    True since the mailbox routing stage grew its cross-shard collective:
+    sharded_protocol_tick shards the arena and the partition mask node-major
+    over 'data' and exchanges src-grouped emit lanes with a tiled
+    lax.all_to_all (ops/mailbox._sharded_mailbox_route_part), so every
+    payload reaches its destination shard's ring inside the one fused
+    launch. Kept as a predicate so a future mesh topology that cannot carry
+    the collective can opt back out to host messages (the engine counts
+    that in sharded_megakernel_fallbacks)."""
+    return True
 
 
 def sharded_deps_step(mesh: Mesh, closure_iters: int = 8):
@@ -308,48 +319,105 @@ def sharded_range_deps_resolve(mesh: Mesh):
         rep2), out_shardings=(out, out))
 
 
+# per-store arena in_specs for shard_map'd resolve stages (key arenas:
+# rows over 'data', buckets over 'model'; range arenas: rows over 'data')
+_KEY_ARENA_SPEC = (P("data", "model"), P("data", None), P("data"), P("data"))
+_RNG_ARENA_SPEC = (P("data"), P("data"), P("data", None), P("data"),
+                   P("data"))
+
+
+def _fused_key_resolve_blocks(nstores, sof, sk, sst, sb, sknd, sl, ars, tbl):
+    """Per-shard LOCAL key-resolve packed blocks, one per store: the body
+    shared by sharded_fused_deps_resolve and the sharded protocol
+    megakernel (must run inside a shard_map over ('data', 'model')). The
+    subject bitmap is built once per shard restricted to the local bucket
+    slice; each arena block applies its store's slot mask and packs its own
+    lane block."""
+    from accord_tpu.ops.kernels import _lex_before, _pack_bits
+    b = sb.shape[0]
+    k_local = ars[0][0].shape[1]
+    base = jax.lax.axis_index("model") * k_local
+    col = sk - base
+    col = jnp.where((col >= 0) & (col < k_local), col, k_local)
+    subj_bm = jnp.zeros((b, k_local), jnp.float32) \
+        .at[sof, col].max(1.0, mode="drop").astype(jnp.bfloat16)
+    outs = []
+    for s in range(nstores):
+        bm, ts, kinds, valid = ars[s]
+        partial = jax.lax.dot_general(
+            subj_bm, bm.astype(jnp.bfloat16),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        overlap = jax.lax.psum(partial, "model") > 0.5
+        witness = tbl[sknd[:, None], kinds[None, :]] == 1
+        before = _lex_before(ts[None, :, :], sb[:, None, :])
+        mine = (sst == sl[s])[:, None]
+        outs.append(_pack_bits(
+            overlap & witness & before & valid[None, :] & mine))
+    return outs
+
+
+def _fused_range_resolve_blocks(nr, nk, model, ivo, ivs, ive, sst, sb, sknd,
+                                srng, rsl, rars, ksl, kars, tbl):
+    """Per-shard LOCAL range-resolve packed blocks -- (r-side list, k-side
+    list), shared like _fused_key_resolve_blocks. NR range arenas answer
+    the interval stab over their 'data' row blocks; NK key arenas contract
+    the subject intervals' bucket coverage over 'model'."""
+    from accord_tpu.ops.kernels import _lex_before, _pack_bits
+    b = sb.shape[0]
+    routs = []
+    for s in range(nr):
+        rs, re_, rts, rkd, rvl = rars[s]
+        rcap_l = rs.shape[0]
+        hit_r = (ivs[:, None] < re_[None, :]) \
+            & (rs[None, :] < ive[:, None])
+        any_r = jnp.zeros((b, rcap_l), jnp.int32) \
+            .at[ivo].max(hit_r.astype(jnp.int32), mode="drop") > 0
+        witness_r = tbl[sknd[:, None], rkd[None, :]] == 1
+        before_r = _lex_before(rts[None, :, :], sb[:, None, :])
+        mine = (sst == rsl[s])[:, None]
+        routs.append(_pack_bits(
+            any_r & witness_r & before_r & rvl[None, :] & mine))
+    kouts = []
+    if nk:
+        cov = _covered_buckets(ivo, ivs, ive, b, kars[0][0].shape[1], model)
+        for s in range(nk):
+            bm, kts, kknd, kvl = kars[s]
+            partial = jax.lax.dot_general(
+                cov, bm.astype(jnp.bfloat16),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            any_k = jax.lax.psum(partial, "model") > 0.5
+            witness_k = tbl[sknd[:, None], kknd[None, :]] == 1
+            before_k = _lex_before(kts[None, :, :], sb[:, None, :])
+            mine = (sst == ksl[s])[:, None] & srng[:, None]
+            kouts.append(_pack_bits(
+                any_k & witness_k & before_k & kvl[None, :] & mine))
+    return routs, kouts
+
+
 @functools.lru_cache(maxsize=32)
 def sharded_fused_deps_resolve(mesh: Mesh, nstores: int):
     """Mesh-sharded twin of ops.kernels.fused_deps_resolve: one call
     resolves subjects against NSTORES arenas, each sharded like
     sharded_deps_resolve (rows over 'data', buckets over 'model'). The
     subject bitmap is built once per shard; each arena block applies its
-    store's slot mask and packs its own lane block, and the per-store
-    blocks concatenate OUTSIDE the shard_map (inside, the 'data'-sharded
-    lane axes would interleave across stores) -- and outside the jit, via
-    _concat_lane_blocks (see its docstring for the sharded-axis concat
-    miscompile it routes around). lru_cached by (mesh, store count) so
-    same-width dispatches share one compiled kernel."""
-    from accord_tpu.ops.kernels import _lex_before, _pack_bits
+    store's slot mask and packs its own lane block
+    (_fused_key_resolve_blocks, shared with the sharded protocol
+    megakernel), and the per-store blocks concatenate OUTSIDE the shard_map
+    (inside, the 'data'-sharded lane axes would interleave across stores)
+    -- and outside the jit, via _concat_lane_blocks (see its docstring for
+    the sharded-axis concat miscompile it routes around). lru_cached by
+    (mesh, store count) so same-width dispatches share one compiled
+    kernel."""
 
     def run(subj_of, subj_keys, subj_store, subj_before, subj_kinds,
             slots, arenas, table):
         def part(sof, sk, sst, sb, sknd, sl, ars, tbl):
-            b = sb.shape[0]
-            k_local = ars[0][0].shape[1]
-            base = jax.lax.axis_index("model") * k_local
-            col = sk - base
-            col = jnp.where((col >= 0) & (col < k_local), col, k_local)
-            subj_bm = jnp.zeros((b, k_local), jnp.float32) \
-                .at[sof, col].max(1.0, mode="drop").astype(jnp.bfloat16)
-            outs = []
-            for s in range(nstores):
-                bm, ts, kinds, valid = ars[s]
-                partial = jax.lax.dot_general(
-                    subj_bm, bm.astype(jnp.bfloat16),
-                    (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32)
-                overlap = jax.lax.psum(partial, "model") > 0.5
-                witness = tbl[sknd[:, None], kinds[None, :]] == 1
-                before = _lex_before(ts[None, :, :], sb[:, None, :])
-                mine = (sst == sl[s])[:, None]
-                outs.append(_pack_bits(
-                    overlap & witness & before & valid[None, :] & mine))
-            return tuple(outs)
+            return tuple(_fused_key_resolve_blocks(
+                nstores, sof, sk, sst, sb, sknd, sl, ars, tbl))
 
-        arena_specs = tuple(
-            (P("data", "model"), P("data", None), P("data"), P("data"))
-            for _ in range(nstores))
+        arena_specs = tuple(_KEY_ARENA_SPEC for _ in range(nstores))
         return shard_map(
             part, mesh=mesh,
             in_specs=(P(None), P(None), P(None), P(None, None), P(None),
@@ -376,53 +444,23 @@ def sharded_fused_range_deps_resolve(mesh: Mesh, nr: int, nk: int):
     (bucket-contracted coverage test over 'model', like
     sharded_range_deps_resolve) answer one fused call; per-store blocks
     concatenate outside the shard_map and outside the jit via
-    _concat_lane_blocks (see its docstring). Empty sides return a (b, 0)
-    packed array the caller discards."""
-    from accord_tpu.ops.kernels import _lex_before, _pack_bits
+    _concat_lane_blocks (see its docstring); the per-shard body is
+    _fused_range_resolve_blocks, shared with the sharded protocol
+    megakernel. Empty sides return a (b, 0) packed array the caller
+    discards."""
     model = mesh.shape["model"]
 
     def run(iv_of, iv_start, iv_end, subj_store, subj_before, subj_kinds,
             subj_is_range, r_slots, rarenas, k_slots, karenas, table):
         def part(ivo, ivs, ive, sst, sb, sknd, srng,
                  rsl, rars, ksl, kars, tbl):
-            b = sb.shape[0]
-            routs = []
-            for s in range(nr):
-                rs, re_, rts, rkd, rvl = rars[s]
-                rcap_l = rs.shape[0]
-                hit_r = (ivs[:, None] < re_[None, :]) \
-                    & (rs[None, :] < ive[:, None])
-                any_r = jnp.zeros((b, rcap_l), jnp.int32) \
-                    .at[ivo].max(hit_r.astype(jnp.int32), mode="drop") > 0
-                witness_r = tbl[sknd[:, None], rkd[None, :]] == 1
-                before_r = _lex_before(rts[None, :, :], sb[:, None, :])
-                mine = (sst == rsl[s])[:, None]
-                routs.append(_pack_bits(
-                    any_r & witness_r & before_r & rvl[None, :] & mine))
-            kouts = []
-            if nk:
-                cov = _covered_buckets(ivo, ivs, ive, b,
-                                       kars[0][0].shape[1], model)
-                for s in range(nk):
-                    bm, kts, kknd, kvl = kars[s]
-                    partial = jax.lax.dot_general(
-                        cov, bm.astype(jnp.bfloat16),
-                        (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32)
-                    any_k = jax.lax.psum(partial, "model") > 0.5
-                    witness_k = tbl[sknd[:, None], kknd[None, :]] == 1
-                    before_k = _lex_before(kts[None, :, :], sb[:, None, :])
-                    mine = (sst == ksl[s])[:, None] & srng[:, None]
-                    kouts.append(_pack_bits(
-                        any_k & witness_k & before_k & kvl[None, :] & mine))
+            routs, kouts = _fused_range_resolve_blocks(
+                nr, nk, model, ivo, ivs, ive, sst, sb, sknd, srng,
+                rsl, rars, ksl, kars, tbl)
             return tuple(routs) + tuple(kouts)
 
-        rarena_specs = tuple(
-            (P("data"), P("data"), P("data", None), P("data"), P("data"))
-            for _ in range(nr))
-        karena_specs = tuple(
-            (P("data", "model"), P("data", None), P("data"), P("data"))
-            for _ in range(nk))
+        rarena_specs = tuple(_RNG_ARENA_SPEC for _ in range(nr))
+        karena_specs = tuple(_KEY_ARENA_SPEC for _ in range(nk))
         return shard_map(
             part, mesh=mesh,
             in_specs=(P(None), P(None), P(None), P(None), P(None, None),
@@ -483,10 +521,14 @@ def sharded_node_tick(mesh: Mesh, key_merge, range_merge, table):
     return packed, rpacked, kpacked
 
 
-@functools.lru_cache(maxsize=8)
-def sharded_finalize_csr(mesh: Mesh):
-    """Mesh-sharded twin of ops.kernels.finalize_csr: the finalized-CSR
-    COMPACTION distributed over 'data' word columns. Each shard holds a
+def _sharded_finalize_body(mesh: Mesh, packed, word_off, kid_rows,
+                           slot_subj, slot_kid, subj_row, act_ts,
+                           out_cap: int):
+    """Mesh-sharded twin of ops.kernels._finalize_csr_body: the
+    finalized-CSR COMPACTION distributed over 'data' word columns, shared
+    by the standalone sharded_finalize_csr jit and the sharded protocol
+    megakernel (which inlines it per canonically-sorted finalize spec).
+    Each shard holds a
     contiguous block of every kid-table row mask and of the packed
     candidate words (P(None, 'data') -- the layout the sharded candidate
     kernels already emit), so the AND + self-bit clear + SWAR popcount all
@@ -516,110 +558,298 @@ def sharded_finalize_csr(mesh: Mesh):
     exactly the arrays the harvest will read back. Overflow keeps the
     same contract
     (indptr[-1] > out_cap; the exact total comes from the gathered counts,
-    never from the possibly-dropped scatters). lru_cached by mesh: every
-    resolver on the mesh shares one compiled kernel per (shape, out_cap)."""
+    never from the possibly-dropped scatters)."""
     from accord_tpu.ops.kernels import _popcount_u32
     data = mesh.shape["data"]
     model = mesh.shape["model"]
 
+    b = packed.shape[0]
+    kc, w = kid_rows.shape
+    blk = jax.lax.dynamic_slice_in_dim(packed, word_off, w, axis=1)
+
+    def part(blk_l, kid_l, ssub, skid, srow):
+        wl = blk_l.shape[1]
+        d = jax.lax.axis_index("data")
+        base_w = d * wl
+        s = ssub.shape[0]
+        ok = (ssub >= 0) & (ssub < b) & (skid >= 0) & (skid < kc)
+        kid_m = kid_l[jnp.clip(skid, 0, kc - 1)]
+        if s % model == 0:
+            # kid-table popcount sharded over 'model': each model
+            # replica bounds a contiguous slot block (the nnz tiers
+            # are 32-multiples, so the split is exact), psum restores
+            # the model-replicated scalar the out_specs promise --
+            # integer partial sums, so bit-identical to the full
+            # reduction the single-device kernel computes
+            mi = jax.lax.axis_index("model")
+            sl = s // model
+            skid_b = jax.lax.dynamic_slice_in_dim(skid, mi * sl, sl)
+            ok_b = jax.lax.dynamic_slice_in_dim(ok, mi * sl, sl)
+            kid_b = kid_l[jnp.clip(skid_b, 0, kc - 1)]
+            bound_l = jax.lax.psum(jnp.sum(jnp.where(
+                ok_b,
+                jnp.sum(_popcount_u32(kid_b), axis=1, dtype=jnp.int32),
+                0), dtype=jnp.int32), "model")
+        else:
+            bound_l = jnp.sum(jnp.where(
+                ok,
+                jnp.sum(_popcount_u32(kid_m), axis=1, dtype=jnp.int32),
+                0), dtype=jnp.int32)
+        so = jnp.clip(ssub, 0, b - 1)
+        m = jnp.where(ok[:, None], blk_l[so] & kid_m, jnp.uint32(0))
+        r = srow[so]
+        widx = base_w + jnp.arange(wl, dtype=jnp.int32)
+        selfbit = jnp.where(
+            (r >= 0)[:, None] & (widx[None, :] == (r >> 5)[:, None]),
+            (jnp.uint32(1) << (r & 31).astype(jnp.uint32))[:, None],
+            jnp.uint32(0))
+        m = m & ~selfbit
+        pop = _popcount_u32(m)                            # i32[S, wl]
+        counts_l = jnp.sum(pop, axis=1, dtype=jnp.int32)  # i32[S]
+        counts_all = jax.lax.all_gather(counts_l, "data")  # i32[D, S]
+        counts = jnp.sum(counts_all, axis=0)
+        seg0 = jnp.cumsum(counts, dtype=jnp.int32) - counts
+        # this shard's exclusive write base within each slot's segment
+        prefix = jnp.sum(jnp.where(
+            jnp.arange(data, dtype=jnp.int32)[:, None] < d,
+            counts_all, 0), axis=0, dtype=jnp.int32)
+        seg_base = seg0 + prefix
+        # local word compaction (kernels._packed_segment_compact with
+        # shard-global bit offsets and row bases)
+        flat_pop = pop.reshape(-1)
+        flat_val = m.reshape(-1)
+        within_seg = jnp.cumsum(pop, axis=1, dtype=jnp.int32) - pop
+        bit_off = (seg_base[:, None] + within_seg).reshape(-1)
+        nz = flat_pop > 0
+        slot = jnp.where(
+            nz, jnp.cumsum(nz.astype(jnp.int32), dtype=jnp.int32) - 1,
+            out_cap)
+        src = jnp.zeros(out_cap, jnp.int32) \
+            .at[slot].set(jnp.arange(s * wl, dtype=jnp.int32),
+                          mode="drop")
+        live = jnp.arange(out_cap, dtype=jnp.int32) \
+            < jnp.sum(nz.astype(jnp.int32))
+        cw_val = jnp.where(live, flat_val[src], jnp.uint32(0))
+        cw_off = bit_off[src]
+        cw_row = (base_w + src % wl) * 32
+        bits = ((cw_val[:, None] >> jnp.arange(32, dtype=jnp.uint32))
+                & 1).astype(jnp.int32)
+        within = jnp.cumsum(bits, axis=1, dtype=jnp.int32) - bits
+        pos = jnp.where((bits > 0) & live[:, None],
+                        cw_off[:, None] + within, out_cap)
+        rows = cw_row[:, None] + jnp.arange(32, dtype=jnp.int32)[None, :]
+        frag = jnp.zeros(out_cap, jnp.int32) \
+            .at[pos.reshape(-1)].set(rows.reshape(-1), mode="drop")
+        return counts_l[None], frag[None], bound_l[None]
+
+    counts_all, frags, bounds = shard_map(
+        part, mesh=mesh,
+        in_specs=(P(None, "data"), P(None, "data"), P(None), P(None),
+                  P(None)),
+        out_specs=(P("data", None), P("data", None), P("data")),
+    )(blk, kid_rows, slot_subj, slot_kid, subj_row)
+    counts = jnp.sum(counts_all, axis=0)
+    indptr = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)])
+    dep_rows = jnp.sum(frags, axis=0)
+    bound = jnp.sum(bounds, dtype=jnp.int32)
+    dep_ts = act_ts[dep_rows]
+    from accord_tpu.ops.kernels import csr_checksum
+    return (indptr, dep_rows, dep_ts, bound,
+            csr_checksum(indptr, dep_rows, dep_ts))
+
+
+@functools.lru_cache(maxsize=8)
+def sharded_finalize_csr(mesh: Mesh):
+    """Standalone jit over _sharded_finalize_body (the unfused dispatch
+    the sharded resolver uses when the megakernel is off). lru_cached by
+    mesh: every resolver on the mesh shares one compiled kernel per
+    (shape, out_cap)."""
+
     def run(packed, word_off, kid_rows, slot_subj, slot_kid,
             subj_row, act_ts, out_cap: int):
-        b = packed.shape[0]
-        kc, w = kid_rows.shape
-        blk = jax.lax.dynamic_slice_in_dim(packed, word_off, w, axis=1)
-
-        def part(blk_l, kid_l, ssub, skid, srow):
-            wl = blk_l.shape[1]
-            d = jax.lax.axis_index("data")
-            base_w = d * wl
-            s = ssub.shape[0]
-            ok = (ssub >= 0) & (ssub < b) & (skid >= 0) & (skid < kc)
-            kid_m = kid_l[jnp.clip(skid, 0, kc - 1)]
-            if s % model == 0:
-                # kid-table popcount sharded over 'model': each model
-                # replica bounds a contiguous slot block (the nnz tiers
-                # are 32-multiples, so the split is exact), psum restores
-                # the model-replicated scalar the out_specs promise --
-                # integer partial sums, so bit-identical to the full
-                # reduction the single-device kernel computes
-                mi = jax.lax.axis_index("model")
-                sl = s // model
-                skid_b = jax.lax.dynamic_slice_in_dim(skid, mi * sl, sl)
-                ok_b = jax.lax.dynamic_slice_in_dim(ok, mi * sl, sl)
-                kid_b = kid_l[jnp.clip(skid_b, 0, kc - 1)]
-                bound_l = jax.lax.psum(jnp.sum(jnp.where(
-                    ok_b,
-                    jnp.sum(_popcount_u32(kid_b), axis=1, dtype=jnp.int32),
-                    0), dtype=jnp.int32), "model")
-            else:
-                bound_l = jnp.sum(jnp.where(
-                    ok,
-                    jnp.sum(_popcount_u32(kid_m), axis=1, dtype=jnp.int32),
-                    0), dtype=jnp.int32)
-            so = jnp.clip(ssub, 0, b - 1)
-            m = jnp.where(ok[:, None], blk_l[so] & kid_m, jnp.uint32(0))
-            r = srow[so]
-            widx = base_w + jnp.arange(wl, dtype=jnp.int32)
-            selfbit = jnp.where(
-                (r >= 0)[:, None] & (widx[None, :] == (r >> 5)[:, None]),
-                (jnp.uint32(1) << (r & 31).astype(jnp.uint32))[:, None],
-                jnp.uint32(0))
-            m = m & ~selfbit
-            pop = _popcount_u32(m)                            # i32[S, wl]
-            counts_l = jnp.sum(pop, axis=1, dtype=jnp.int32)  # i32[S]
-            counts_all = jax.lax.all_gather(counts_l, "data")  # i32[D, S]
-            counts = jnp.sum(counts_all, axis=0)
-            seg0 = jnp.cumsum(counts, dtype=jnp.int32) - counts
-            # this shard's exclusive write base within each slot's segment
-            prefix = jnp.sum(jnp.where(
-                jnp.arange(data, dtype=jnp.int32)[:, None] < d,
-                counts_all, 0), axis=0, dtype=jnp.int32)
-            seg_base = seg0 + prefix
-            # local word compaction (kernels._packed_segment_compact with
-            # shard-global bit offsets and row bases)
-            flat_pop = pop.reshape(-1)
-            flat_val = m.reshape(-1)
-            within_seg = jnp.cumsum(pop, axis=1, dtype=jnp.int32) - pop
-            bit_off = (seg_base[:, None] + within_seg).reshape(-1)
-            nz = flat_pop > 0
-            slot = jnp.where(
-                nz, jnp.cumsum(nz.astype(jnp.int32), dtype=jnp.int32) - 1,
-                out_cap)
-            src = jnp.zeros(out_cap, jnp.int32) \
-                .at[slot].set(jnp.arange(s * wl, dtype=jnp.int32),
-                              mode="drop")
-            live = jnp.arange(out_cap, dtype=jnp.int32) \
-                < jnp.sum(nz.astype(jnp.int32))
-            cw_val = jnp.where(live, flat_val[src], jnp.uint32(0))
-            cw_off = bit_off[src]
-            cw_row = (base_w + src % wl) * 32
-            bits = ((cw_val[:, None] >> jnp.arange(32, dtype=jnp.uint32))
-                    & 1).astype(jnp.int32)
-            within = jnp.cumsum(bits, axis=1, dtype=jnp.int32) - bits
-            pos = jnp.where((bits > 0) & live[:, None],
-                            cw_off[:, None] + within, out_cap)
-            rows = cw_row[:, None] + jnp.arange(32, dtype=jnp.int32)[None, :]
-            frag = jnp.zeros(out_cap, jnp.int32) \
-                .at[pos.reshape(-1)].set(rows.reshape(-1), mode="drop")
-            return counts_l[None], frag[None], bound_l[None]
-
-        counts_all, frags, bounds = shard_map(
-            part, mesh=mesh,
-            in_specs=(P(None, "data"), P(None, "data"), P(None), P(None),
-                      P(None)),
-            out_specs=(P("data", None), P("data", None), P("data")),
-        )(blk, kid_rows, slot_subj, slot_kid, subj_row)
-        counts = jnp.sum(counts_all, axis=0)
-        indptr = jnp.concatenate(
-            [jnp.zeros(1, jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)])
-        dep_rows = jnp.sum(frags, axis=0)
-        bound = jnp.sum(bounds, dtype=jnp.int32)
-        dep_ts = act_ts[dep_rows]
-        from accord_tpu.ops.kernels import csr_checksum
-        return (indptr, dep_rows, dep_ts, bound,
-                csr_checksum(indptr, dep_rows, dep_ts))
+        return _sharded_finalize_body(mesh, packed, word_off, kid_rows,
+                                      slot_subj, slot_kid, subj_row,
+                                      act_ts, out_cap)
 
     return jax.jit(run, static_argnames=("out_cap",))
+
+
+# -- the sharded protocol megakernel ------------------------------------------
+
+_SHARDED_TICK_FNS: dict = {}
+
+
+def _sharded_tick_fn(mesh: Mesh, statics):
+    """Build (or fetch) the one fused mesh program for a tick-signature
+    multiset: a single jax.jit composing shard_map regions for the
+    node-lane resolve, every finalize compaction, the cross-shard mailbox
+    exchange, and the replicated cmd/quorum/repair stages -- one XLA
+    executable, so the engine's launch ledger counts exactly one dispatch
+    per cluster tick, like the single-device _protocol_tick_fn."""
+    key = (mesh, statics)
+    fn = _SHARDED_TICK_FNS.get(key)
+    if fn is not None:
+        return fn
+    has_key, has_rng, fin_statics, cmd_promotes, qsize, has_mail, \
+        n_repairs = statics
+    from accord_tpu.ops import kernels as _k
+    from accord_tpu.ops.mailbox import _sharded_mailbox_route_part
+    data = mesh.shape["data"]
+    rep = NamedSharding(mesh, P(None, None))
+
+    def assemble(blocks):
+        # replicate each store's P(None, 'data') lane block before the
+        # lane-axis concat -- the in-jit twin of _concat_lane_blocks'
+        # workaround for the sharded-axis concat miscompile
+        blocks = [jax.lax.with_sharding_constraint(blk, rep)
+                  for blk in blocks]
+        return blocks[0] if len(blocks) == 1 \
+            else jnp.concatenate(blocks, axis=1)
+
+    def run(witness_table, key_in, rng_in, fin_in, cmd_in, q_in,
+            mail_in, rep_in):
+        packed = ()
+        rng_out = ()
+        if has_key:
+            sof, sk, sst, sb, sknd, sl, blocks = key_in
+            nstores = len(blocks)
+
+            def kpart(sof, sk, sst, sb, sknd, sl, ars, tbl):
+                return tuple(_fused_key_resolve_blocks(
+                    nstores, sof, sk, sst, sb, sknd, sl, ars, tbl))
+
+            blks = shard_map(
+                kpart, mesh=mesh,
+                in_specs=(P(None), P(None), P(None), P(None, None),
+                          P(None), P(None),
+                          tuple(_KEY_ARENA_SPEC for _ in range(nstores)),
+                          P(None, None)),
+                out_specs=tuple(P(None, "data") for _ in range(nstores)),
+            )(sof, sk, sst, sb, sknd, sl, blocks, witness_table)
+            packed = assemble(list(blks))
+        if has_rng:
+            (iv_of, iv_s, iv_e, snode, sb, sknd, srng, r_slots, r_blocks,
+             k_slots, k_blocks) = rng_in
+            nr, nk = len(r_blocks), len(k_blocks)
+            model = mesh.shape["model"]
+
+            def rpart(ivo, ivs, ive, sst, sbx, skndx, srngx, rsl, rars,
+                      ksl, kars, tbl):
+                routs, kouts = _fused_range_resolve_blocks(
+                    nr, nk, model, ivo, ivs, ive, sst, sbx, skndx, srngx,
+                    rsl, rars, ksl, kars, tbl)
+                return tuple(routs) + tuple(kouts)
+
+            blks = shard_map(
+                rpart, mesh=mesh,
+                in_specs=(P(None), P(None), P(None), P(None),
+                          P(None, None), P(None), P(None), P(None),
+                          tuple(_RNG_ARENA_SPEC for _ in range(nr)),
+                          P(None),
+                          tuple(_KEY_ARENA_SPEC for _ in range(nk)),
+                          P(None, None)),
+                out_specs=tuple(P(None, "data") for _ in range(nr + nk)),
+            )(iv_of, iv_s, iv_e, snode, sb, sknd, srng, r_slots, r_blocks,
+              k_slots, k_blocks, witness_table)
+            b = sb.shape[0]
+            rp = assemble(list(blks[:nr])) if nr \
+                else jnp.zeros((b, 0), jnp.uint32)
+            kp = assemble(list(blks[nr:])) if nk \
+                else jnp.zeros((b, 0), jnp.uint32)
+            rng_out = (rp, kp)
+        fin_outs = []
+        for spec, args in zip(fin_statics, fin_in):
+            kind = spec[0]
+            if kind == "range":
+                # the range arena is tiny (tens of rows): the interval
+                # stab runs replicated, like the unfused sharded path
+                (iv_of, iv_s, iv_e, ent_ok, f_sb, f_sknd,
+                 (r_start, r_end, r_ts, r_kinds, r_valid)) = args
+                fin_outs.append(_k._range_finalize_csr_body(
+                    iv_of, iv_s, iv_e, ent_ok, f_sb, f_sknd,
+                    r_start, r_end, r_ts, r_kinds, r_valid,
+                    witness_table, spec[1]))
+            else:
+                _kk, rows, words, out_cap = spec
+                (r0, w_lo, word_off, kid_rows, slot_subj, slot_kid,
+                 subj_row, act_ts) = args
+                src = packed if kind == "key" else rng_out[1]
+                blk = jax.lax.dynamic_slice(src, (r0, w_lo), (rows, words))
+                fin_outs.append(_sharded_finalize_body(
+                    mesh, blk, word_off, kid_rows, slot_subj, slot_kid,
+                    subj_row, act_ts, out_cap))
+        cmd_outs = []
+        for promote, args in zip(cmd_promotes, cmd_in):
+            cmd_outs.append(_k._cmd_tick_body(*args, promote=promote))
+        q_out = ()
+        if qsize is not None:
+            q_txn, q_ts, q_code, q_valid = q_in
+            fast = q_valid & ((q_code & 7) == _k.CMD_OUT_SUCCESS) \
+                & jnp.all(q_ts == q_txn, axis=1)
+            same = jnp.all(q_txn[:, None, :] == q_txn[None, :, :], axis=2)
+            votes = jnp.sum(same & fast[None, :], axis=1, dtype=jnp.int32)
+            q_out = (fast, votes, fast & (votes >= qsize))
+        mail_out = ()
+        if has_mail:
+            def mpart(*args):
+                return _sharded_mailbox_route_part(data, "data", *args)
+
+            mail_out = shard_map(
+                mpart, mesh=mesh,
+                in_specs=(P("data", None), P("data", None), P("data"),
+                          P("data"), P("data"), P("data"), P("data"),
+                          P("data"), P("data", None), P("data", None)),
+                out_specs=(P("data", None), P("data", None),
+                           P("data", None), P("data", None), P("data")),
+            )(*mail_in)
+        rep_outs = tuple(_k._cmd_repair_body(*rep_in[i])
+                         for i in range(n_repairs))
+        return (packed, rng_out, tuple(fin_outs), tuple(cmd_outs), q_out,
+                mail_out, rep_outs)
+
+    fn = jax.jit(run)
+    _SHARDED_TICK_FNS[key] = fn
+    return fn
+
+
+def sharded_protocol_tick(mesh: Mesh, witness_table, key_in=None,
+                          rng_in=None, fins=(), cmds=(), quorum=None,
+                          quorum_size=1, mailbox=None, cmd_repairs=()):
+    """Multi-chip twin of ops.kernels.protocol_tick: ONE fused mesh
+    program per cluster tick. Same argument contract (see protocol_tick's
+    docstring) with `mesh` prepended; key_in/rng_in are the node-lane
+    merge inputs sharded_node_tick would dispatch, fins the same finalize
+    specs (key/rkey spans compact through _sharded_finalize_body's
+    word-column sharding), and `mailbox` a MailboxPlane staged with
+    shards == mesh.shape['data'] so the routing stage's all_to_all lands
+    cross-shard payloads. Finalize specs canonically sort by static
+    signature via kernels._fin_split -- the compile cache keys on the
+    tick-signature multiset exactly as the single-device path does."""
+    from accord_tpu.ops.kernels import _fin_split, _fin_unsort
+    fin_statics, fin_traced, order = _fin_split(fins)
+    cmd_statics = tuple(bool(c[-1]) for c in cmds)
+    cmd_traced = tuple(tuple(c[:-1]) for c in cmds)
+    statics = (key_in is not None, rng_in is not None, tuple(fin_statics),
+               cmd_statics, int(quorum_size) if quorum is not None else None,
+               mailbox is not None, len(cmd_repairs))
+    fn = _sharded_tick_fn(mesh, statics)
+    packed, rng_out, fin_outs, cmd_outs, q_out, mail_out, rep_outs = fn(
+        witness_table,
+        tuple(key_in) if key_in is not None else (),
+        tuple(rng_in) if rng_in is not None else (),
+        tuple(fin_traced), cmd_traced,
+        tuple(quorum) if quorum is not None else (),
+        tuple(mailbox) if mailbox is not None else (),
+        tuple(tuple(r) for r in cmd_repairs))
+    return (packed, rng_out, _fin_unsort(fin_outs, order), cmd_outs,
+            q_out, mail_out, rep_outs)
+
+
+def sharded_protocol_tick_cache_sizes() -> int:
+    """Total compiled sharded_protocol_tick variants across every
+    (mesh, static signature) -- folded into kernels.jit_cache_sizes."""
+    return sum(f._cache_size() for f in _SHARDED_TICK_FNS.values())
 
 
 def warmup_sharded(mesh: Mesh, num_buckets: int = 256, cap: int = 4096,
@@ -635,7 +865,9 @@ def warmup_sharded(mesh: Mesh, num_buckets: int = 256, cap: int = 4096,
                    cmd_op_tiers: Optional[Tuple[int, ...]] = None,
                    cmd_promote_modes: Tuple[bool, ...] = (False,),
                    node_tiers: Tuple[int, ...] = (),
-                   node_batch_tiers: Optional[Tuple[int, ...]] = None) -> None:
+                   node_batch_tiers: Optional[Tuple[int, ...]] = None,
+                   mega_quorum_sizes: Tuple[int, ...] = (),
+                   mega_lane_tiers: Optional[Tuple[int, ...]] = None) -> None:
     """Pre-compile the sharded hot kernels' (batch tier, nnz tier, store
     tier) jit cross product (the sharded twin of ops.resolver.warmup; same
     padding ladders the overlapped pipeline dispatches). Store tiers >= 2
@@ -653,7 +885,10 @@ def warmup_sharded(mesh: Mesh, num_buckets: int = 256, cap: int = 4096,
     warms the cluster-tick node-lane path (`sharded_node_tick` delegates to
     the fused kernels at the merge's block-count tier) across every
     (block tier x merged-row tier x nnz tier) -- the sharded twin of
-    ops.resolver.warmup's node_tiers."""
+    ops.resolver.warmup's node_tiers. `mega_quorum_sizes` (opt-in) warms
+    the sharded protocol megakernel's quorum-count stage across the lane
+    tiers a megakernel burn pads PreAccept spans to -- the sharded twin of
+    resolver.warmup's mega block."""
     from accord_tpu.ops.encoding import WITNESS_TABLE
     from accord_tpu.ops.kernels import NNZ_TIERS
     if nnz_tiers is None:
@@ -745,6 +980,19 @@ def warmup_sharded(mesh: Mesh, num_buckets: int = 256, cap: int = 4096,
                                 table)
                     out = frkern(of, zz, zz, snode, sb, sknd, srng, slots,
                                  rarenas, slots, arenas, table)
+    if mega_quorum_sizes:
+        from accord_tpu.ops.tiers import MEGA_LANE_TIERS
+        lt = (tuple(mega_lane_tiers) if mega_lane_tiers is not None
+              else MEGA_LANE_TIERS[:2])
+        for qs in mega_quorum_sizes:
+            for t in lt:
+                out = sharded_protocol_tick(
+                    mesh, table,
+                    quorum=(jnp.zeros((t, 3), jnp.int32),
+                            jnp.zeros((t, 3), jnp.int32),
+                            jnp.zeros(t, jnp.int32),
+                            jnp.zeros(t, bool)),
+                    quorum_size=qs)[4][2]
     if out is not None:
         jax.block_until_ready(out)
 

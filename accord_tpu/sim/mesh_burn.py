@@ -36,8 +36,13 @@ pure host slicing of the one contiguous readback (node_lane.MergedView),
 so post-warmup a cluster tick costs exactly one device program launch
 (`launches_per_tick`). mesh_tick=False (the per-node loop) and
 megakernel=False (the unfused <=2-dispatch merge) stay live as
-bit-identical differential baselines under --reconcile. The sharded path
-(parallel/mesh.sharded_node_tick) keeps the unfused dispatch pair.
+bit-identical differential baselines under --reconcile. On a sharded
+resolver the same megakernel staging launches through
+parallel/mesh.sharded_protocol_tick instead -- one fused MESH program per
+cluster tick, replica payloads riding the cross-shard mailbox all_to_all
+-- with work that cannot fuse (heterogeneous resolver configs, unrecorded
+plan args) counted in `sharded_megakernel_fallbacks` and launched through
+the unfused sharded pair.
 
 CLI:  python -m accord_tpu.sim.mesh_burn --seed 1 --ops 500 --nodes 8
       [--python-loop]  per-node launch loop (the differential baseline)
@@ -103,6 +108,7 @@ class ClusterTickEngine:
         self.node_lane_dispatches = 0
         self.mesh_tick_fallbacks = 0
         self.megakernel_dispatches = 0
+        self.sharded_megakernel_fallbacks = 0
         self.fastpath_quorum_txns = 0
         # per-plan deferred kernel calls staged this run -- in loop mode
         # each is one device dispatch; in mesh mode they collapse into
@@ -125,6 +131,10 @@ class ClusterTickEngine:
         self._cmd_lanes: List[tuple] = []
         self._pending_quorum: List[tuple] = []
         self._warned_cfgs: set = set()
+        self._warned_sharded: set = set()
+        # set when a sharded mesh cannot carry the message plane: keeps
+        # host messages without re-probing (and re-counting) every note
+        self._mail_plane_blocked = False
 
     def adopt(self, resolver):
         """Attach this engine as the resolver's tick driver (wrap the
@@ -146,6 +156,7 @@ class ClusterTickEngine:
                 if self._rows_total else 0.0),
             "mesh_tick_fallbacks": self.mesh_tick_fallbacks,
             "megakernel_dispatches": self.megakernel_dispatches,
+            "sharded_megakernel_fallbacks": self.sharded_megakernel_fallbacks,
             "launches_per_tick": (self.protocol_launches / t) if t else 0.0,
             "fastpath_quorum_txns": self.fastpath_quorum_txns,
         }
@@ -205,12 +216,26 @@ class ClusterTickEngine:
         per (resolver, node); the first note after an idle period arms the
         cluster tick at that node's effective window."""
         self._queue = node.scheduler.queue
-        if self.device_messages and self._net is None:
+        if self.device_messages and self._net is None \
+                and not self._mail_plane_blocked:
             net = getattr(getattr(node, "message_sink", None),
                           "network", None)
             if net is not None and hasattr(net, "attach_engine"):
-                net.attach_engine(self)
-                self._net = net
+                shards = 1
+                mesh = getattr(resolver, "mesh", None)
+                if mesh is not None:
+                    from accord_tpu.parallel.mesh import (
+                        mesh_supports_message_plane)
+                    if mesh_supports_message_plane(mesh):
+                        shards = mesh.shape["data"]
+                    else:
+                        # messages keep the host path; payloads never stage
+                        self._mail_plane_blocked = True
+                        self._note_sharded_fallback(
+                            "mesh does not support the message plane")
+                if not self._mail_plane_blocked:
+                    net.attach_engine(self, shards=shards)
+                    self._net = net
         key = (id(resolver), id(node))
         if key not in self._pending:
             self._pending[key] = (resolver, node)
@@ -278,6 +303,7 @@ class ClusterTickEngine:
         run the stock per-plan path against bit-identical buffers."""
         from accord_tpu.ops import node_lane as nl
         res0 = staged[0][0]
+        mesh = getattr(res0, "mesh", None)
         key_entries: List[tuple] = []
         rng_entries: List[tuple] = []
         lane_nodes = set()
@@ -291,10 +317,15 @@ class ClusterTickEngine:
                     # own kernels (still correct, just not merged)
                     if plan.key_call is not None or plan.range_call is not None:
                         self.mesh_tick_fallbacks += 1
+                        if self.megakernel and mesh is not None:
+                            self._note_sharded_fallback(
+                                "heterogeneous resolver config")
                     continue
                 if (plan.key_call is not None and plan.key_args is None) or \
                         (plan.range_call is not None and plan.range_args is None):
                     self.mesh_tick_fallbacks += 1
+                    if self.megakernel and mesh is not None:
+                        self._note_sharded_fallback("unrecorded plan args")
                     continue
                 if plan.key_args is not None:
                     key_entries.append((plan, plan.key_args))
@@ -311,10 +342,9 @@ class ClusterTickEngine:
             rm = nl.build_range_merge(rng_entries, res0._pad_key_block,
                                       res0._pad_range_block,
                                       res0.pad_node_tiers)
-        mesh = getattr(res0, "mesh", None)
-        if self.megakernel and mesh is None:
+        if self.megakernel:
             self._megakernel_launch(staged, key_entries, rng_entries,
-                                    km, rm, lane_nodes, nl, res0)
+                                    km, rm, lane_nodes, nl, res0, mesh)
             return
         if mesh is not None:
             from accord_tpu.parallel.mesh import sharded_node_tick
@@ -385,8 +415,22 @@ class ClusterTickEngine:
             "with %s(num_buckets=%s); its plans launch unfused "
             "(counted in mesh_tick_fallbacks)", *sig)
 
+    def _note_sharded_fallback(self, reason: str) -> None:
+        """Satellite diagnostics mirroring mesh_tick_fallbacks' convention
+        for the sharded megakernel: every piece of work the fused mesh
+        program cannot carry bumps the counter, and each distinct reason
+        logs once per engine so a degraded multi-chip run is visible
+        without flooding the burn."""
+        self.sharded_megakernel_fallbacks += 1
+        if reason not in self._warned_sharded:
+            self._warned_sharded.add(reason)
+            logger.warning(
+                "sharded megakernel: %s -- that work keeps the unfused "
+                "sharded path (counted in sharded_megakernel_fallbacks)",
+                reason)
+
     def _megakernel_launch(self, staged, key_entries, rng_entries, km, rm,
-                           lane_nodes, nl, res0) -> None:
+                           lane_nodes, nl, res0, mesh=None) -> None:
         """ONE fused device program for the whole cluster tick
         (ops/kernels.protocol_tick): the merged key+range resolve, every
         merged plan's finalize compaction demuxed in-kernel at its merge
@@ -396,11 +440,22 @@ class ClusterTickEngine:
         then every plan launches through the stock path -- fault draws,
         harvest scheduling, decode, and generation pins are untouched, so
         histories stay bit-identical to the unfused merge and to the
-        per-node loop."""
+        per-node loop. With `mesh` set (sharded resolvers) the identical
+        staging launches through parallel/mesh.sharded_protocol_tick --
+        the same one-launch ledger, the resolve/finalize stages sharded
+        over the mesh, and the mailbox stage exchanging cross-shard
+        payloads in-program."""
+        import functools
+
         import jax.numpy as jnp
 
         from accord_tpu.ops.kernels import protocol_tick
         from accord_tpu.ops.tiers import mega_lane_tier
+        if mesh is not None:
+            from accord_tpu.parallel.mesh import sharded_protocol_tick
+            tick = functools.partial(sharded_protocol_tick, mesh)
+        else:
+            tick = protocol_tick
 
         key_in = rng_in = None
         if km is not None:
@@ -474,7 +529,7 @@ class ClusterTickEngine:
         if km is not None or rm is not None or fins or quorum is not None \
                 or mail is not None or rep_blocks:
             (packed_out, rng_out, fin_outs, _cmd, q_out, mail_out,
-             rep_outs) = protocol_tick(
+             rep_outs) = tick(
                 res0._table, key_in=key_in, rng_in=rng_in,
                 fins=tuple(fins), quorum=quorum,
                 quorum_size=self.quorum_size, mailbox=mail,
@@ -558,9 +613,10 @@ def run_mesh_burn(seed: int, ops: int = 500, *, nodes: int = 8,
     node-lane dispatch per cluster tick; mesh_tick=False launches the same
     plans through the per-node Python loop (the bit-identical baseline);
     megakernel=True fuses the whole tick into one protocol_tick program
-    (single device -- the sharded path keeps the unfused dispatch pair).
-    Returns (report, engine) -- the report's counters already carry the
-    engine's node-lane metrics."""
+    (sharded=True routes the same staging through the sharded protocol
+    megakernel, one fused mesh program per tick). Returns
+    (report, engine) -- the report's counters already carry the engine's
+    node-lane metrics."""
     from accord_tpu.ops.resolver import BatchDepsResolver
 
     eng = engine or ClusterTickEngine(mesh_tick=mesh_tick,
@@ -621,6 +677,9 @@ def main(argv=None) -> int:
     ap.add_argument("--cmd-plane-authoritative", action="store_true")
     ap.add_argument("--python-loop", action="store_true",
                     help="per-node launch loop (the differential baseline)")
+    ap.add_argument("--sharded", action="store_true",
+                    help="run resolvers on the device mesh (with "
+                         "--megakernel: one shard_map program per tick)")
     ap.add_argument("--megakernel", action="store_true",
                     help="one fused protocol_tick program per cluster tick")
     ap.add_argument("--device-messages", action="store_true",
@@ -642,6 +701,7 @@ def main(argv=None) -> int:
             cmd_plane=args.cmd_plane or args.cmd_plane_authoritative,
             cmd_plane_authoritative=args.cmd_plane_authoritative,
             mesh_tick=not args.python_loop,
+            sharded=args.sharded,
             megakernel=args.megakernel or args.device_messages,
             device_messages=args.device_messages)
         try:

@@ -353,16 +353,20 @@ class DeviceMessageNetwork(SimNetwork):
         }
 
     # -- engine attachment / device staging ---------------------------------
-    def attach_engine(self, engine) -> None:
+    def attach_engine(self, engine, shards: int = 1) -> None:
         """Called by ClusterTickEngine once it discovers this network; from
-        here on flushed payload bytes ride the device mailbox arena."""
+        here on flushed payload bytes ride the device mailbox arena.
+        `shards` > 1 (the engine passes the mesh's 'data' extent when the
+        resolver is sharded) lays the plane out node-major over shards so
+        the sharded megakernel's all_to_all routing stage can carry it."""
         if self._engine is engine:
             return
         from accord_tpu.ops.mailbox import MailboxPlane
         self._engine = engine
         self._plane = MailboxPlane(max(self.nodes, default=0),
                                    depth=self.mailbox_depth,
-                                   words=self.mailbox_words)
+                                   words=self.mailbox_words,
+                                   shards=shards)
 
     def message_kind(self, name: str) -> int:
         k = self._kinds.get(name)

@@ -1583,9 +1583,11 @@ def bench_megakernel(quick: bool):
     same convention as bench_mesh_burn: on the CPU backend both modes
     are bound by identical host-side encode, so the wall ratio hovers at
     ~1 and the structural ratio is the portable number. A MULTICHIP leg
-    asserts the single-device-by-design guard: a megakernel engine on
-    the sharded 8-device mesh must fall back to the unfused pair and
-    still commit bit-identical histories."""
+    gates the SHARDED megakernel: on the 8-device mesh the fused tick
+    lowers to sharded_protocol_tick (one shard_map program per cluster
+    tick), and the leg asserts fused dispatches fired, launches per tick
+    exactly 1.0, zero sharded_megakernel_fallbacks, zero post-warmup
+    recompiles, and a history bit-identical to the per-node loop."""
     from accord_tpu.ops.kernels import jit_cache_sizes
     from accord_tpu.sim.mesh_burn import run_mesh_burn
 
@@ -1657,9 +1659,11 @@ def bench_megakernel(quick: bool):
         raise AssertionError(
             f"megakernel sweep minted compiles in the timed window: {diff}")
 
-    # MULTICHIP: megakernel=True on the sharded mesh must take the
-    # single-device guard (fused dispatches stay 0, the sharded unfused
-    # pair runs) and still match the per-node loop bit for bit
+    # MULTICHIP: megakernel=True on the sharded 8-device mesh lowers the
+    # fused tick to sharded_protocol_tick -- ONE shard_map program per
+    # cluster tick. Gate the fused sharded path directly: dispatches
+    # fired, exactly one launch per tick, zero fallbacks to the unfused
+    # pair, zero post-warmup recompiles, history == per-node loop.
     import subprocess
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
@@ -1667,19 +1671,38 @@ def bench_megakernel(quick: bool):
                           ).strip())
     snippet = (
         "import json, jax\n"
+        "from accord_tpu.ops.kernels import jit_cache_sizes\n"
         "from accord_tpu.sim.mesh_burn import run_mesh_burn\n"
         "rkw = dict(num_buckets=256, initial_cap=512)\n"
         "kw = dict(nodes=4, sharded=True, collect_log=True,\n"
         "          resolver_kwargs=rkw)\n"
+        f"run_mesh_burn({seed}, 40, mesh_tick=True, megakernel=True,"
+        " **kw)\n"
+        f"run_mesh_burn({seed}, 40, mesh_tick=False, **kw)\n"
+        "cache0 = jit_cache_sizes()\n"
         f"sh, eng = run_mesh_burn({seed}, 40, mesh_tick=True,\n"
         f"                        megakernel=True, **kw)\n"
         f"lp, _ = run_mesh_burn({seed}, 40, mesh_tick=False, **kw)\n"
         "assert sh.log == lp.log, 'MULTICHIP megakernel burn diverged'\n"
+        "cache1 = jit_cache_sizes()\n"
+        "assert cache1 == cache0, \\\n"
+        "    f'warm sharded burn minted compiles: {cache0} -> {cache1}'\n"
         "snap = eng.snapshot()\n"
-        "assert snap['megakernel_dispatches'] == 0, \\\n"
-        "    'sharded mesh must not take the single-device fused path'\n"
+        "assert snap['megakernel_dispatches'] > 0, \\\n"
+        "    'sharded mesh never took the fused sharded path'\n"
+        "assert snap['launches_per_tick'] == 1.0, \\\n"
+        "    f\"sharded fused burn took {snap['launches_per_tick']:.2f}"
+        " launches/tick\"\n"
+        "assert snap['sharded_megakernel_fallbacks'] == 0, \\\n"
+        "    f\"{snap['sharded_megakernel_fallbacks']} ticks fell back to"
+        " the unfused pair\"\n"
         "print(json.dumps({'devices': len(jax.devices()),\n"
-        "                  'megakernel_dispatches': 0,\n"
+        "                  'megakernel_dispatches':"
+        " snap['megakernel_dispatches'],\n"
+        "                  'launches_per_tick':"
+        " snap['launches_per_tick'],\n"
+        "                  'sharded_megakernel_fallbacks': 0,\n"
+        "                  'recompiles_post_warmup': 0,\n"
         "                  'history_identical': True}))\n")
     out = subprocess.run([sys.executable, "-c", snippet], env=env,
                          capture_output=True, text=True, timeout=900)
@@ -1697,6 +1720,7 @@ def bench_megakernel(quick: bool):
         # headline keys (main() grafts messages_per_host_callback from the
         # message-plane leg next to these)
         "launches_per_tick": 1.0,    # asserted per size above
+        "sharded_launches_per_tick": multichip["launches_per_tick"],
         "wall_committed_per_s": largest["mega_committed_per_s"],
         "sweep": {str(n): r for n, r in results.items()},
         "recompiles_in_sweep": 0,    # asserted above
@@ -1717,7 +1741,11 @@ def bench_message_plane(quick: bool):
     jit_cache_sizes() surface. Two parity side legs ride along gated on
     history equality only: a chaos leg (drops + partitions) and a 3-region
     ASYMMETRIC regional-latency LinkMatrix leg that the host path also
-    runs -- one matrix feeding both modes bit-identically."""
+    runs -- one matrix feeding both modes bit-identically. A MULTICHIP
+    leg reruns the contract on the sharded 8-device mesh, where the
+    mailbox stage rides sharded_protocol_tick's cross-shard all_to_all
+    hop: same hard gates (lpt exactly 1.0, zero spills/fallbacks, >= 10x
+    collapse, zero post-warmup recompiles, history == host path)."""
     from accord_tpu.ops.kernels import jit_cache_sizes
     from accord_tpu.sim.mesh_burn import run_mesh_burn
     from accord_tpu.sim.network import LinkMatrix
@@ -1829,13 +1857,72 @@ def bench_message_plane(quick: bool):
             f"message-plane sweep minted compiles in the timed window: "
             f"{diff}")
 
+    # MULTICHIP: the mailbox stage on the sharded 8-device mesh -- emit
+    # lanes grouped by (src shard, dst shard), shipped by the tiled
+    # all_to_all inside sharded_protocol_tick. Same contract as the
+    # single-device sweep, gated in-subprocess: one launch per tick,
+    # zero spills / verify fallbacks / unfused fallbacks, >= 10x host
+    # callback collapse, zero post-warmup recompiles, and a history
+    # bit-identical to the host message path.
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8"
+                          ).strip())
+    snippet = (
+        "import json, jax\n"
+        "from accord_tpu.ops.kernels import jit_cache_sizes\n"
+        "from accord_tpu.sim.mesh_burn import run_mesh_burn\n"
+        "kw = dict(nodes=16, rf=5, concurrency=32, sharded=True,\n"
+        "          megakernel=True, collect_log=True)\n"
+        "# warm BOTH modes: the host-path run's tick statics (no mailbox\n"
+        "# stage) compile separately from the device-message tick's\n"
+        f"run_mesh_burn({seed}, 50, device_messages=True, **kw)\n"
+        f"run_mesh_burn({seed}, 50, **kw)\n"
+        "cache0 = jit_cache_sizes()\n"
+        f"dev, eng = run_mesh_burn({seed}, 50, device_messages=True,"
+        " **kw)\n"
+        f"host, _ = run_mesh_burn({seed}, 50, **kw)\n"
+        "assert dev.log == host.log, 'MULTICHIP message leg diverged'\n"
+        "assert jit_cache_sizes() == cache0, \\\n"
+        "    'warm sharded message burn minted compiles'\n"
+        "c = dev.counters\n"
+        "assert c['launches_per_tick'] == 1.0, c['launches_per_tick']\n"
+        "assert c['mailbox_overflow_spills'] == 0\n"
+        "assert c['mailbox_verify_fallbacks'] == 0\n"
+        "assert c['sharded_megakernel_fallbacks'] == 0\n"
+        "assert c['device_messages_delivered'] > 0\n"
+        "assert c['messages_per_host_callback'] >= 10.0, \\\n"
+        "    c['messages_per_host_callback']\n"
+        "print(json.dumps({'devices': len(jax.devices()),\n"
+        "                  'launches_per_tick': 1.0,\n"
+        "                  'messages_per_host_callback':\n"
+        "                      c['messages_per_host_callback'],\n"
+        "                  'device_messages_delivered':\n"
+        "                      c['device_messages_delivered'],\n"
+        "                  'sharded_megakernel_fallbacks': 0,\n"
+        "                  'recompiles_post_warmup': 0,\n"
+        "                  'history_identical': True}))\n")
+    out = subprocess.run([sys.executable, "-c", snippet], env=env,
+                         capture_output=True, text=True, timeout=1800)
+    if out.returncode != 0:
+        raise AssertionError(
+            f"MULTICHIP message-plane leg failed: {out.stderr[-800:]}")
+    multichip = json.loads(out.stdout.strip().splitlines()[-1])
+    if multichip["devices"] < 8:
+        raise AssertionError(
+            f"MULTICHIP message-plane leg ran on "
+            f"{multichip['devices']} devices")
+
     return {
         "seed": seed,
         "messages_per_host_callback": round(collapse, 2),
+        "sharded_launches_per_tick": multichip["launches_per_tick"],
         "sweep": {str(n): r for n, r in results.items()},
         "chaos": chaos,
         "regional": regional,
         "recompiles_in_sweep": 0,    # asserted above
+        "multichip": multichip,
     }
 
 
