@@ -62,7 +62,7 @@ class _Tracked:
 class ProgressEngine:
     def __init__(self, node=None, interval_ms: float = 250.0,
                  stall_ms: float = 1500.0, home_defer: float = 3.0,
-                 inform_home: bool = True):
+                 inform_home: bool = True, recovery_scan=None):
         self.node = None
         self.rng = None
         self.interval_ms = interval_ms
@@ -74,6 +74,13 @@ class ProgressEngine:
         # every-replica-probes behavior (the gossip test compares the two)
         self.home_defer = home_defer
         self.inform_home = inform_home
+        # stuck-waiter sweep candidate selection (ops/cmd_plane recovery
+        # scan): None walks every live waiter (the reference path); "host"
+        # pre-filters through the arena shadows' stall predicate; "device"
+        # answers it as ONE recovery_scan query per sweep (host-verified,
+        # counted fallback) -- "host" and "device" are bit-identical by
+        # construction, the differential the storm bench drives
+        self.recovery_scan = recovery_scan
         self.tracked: Dict[TxnId, _Tracked] = {}
         self._scheduled = False
         if node is not None:
@@ -192,7 +199,7 @@ class ProgressEngine:
         not every command; stale index entries self-clean here."""
         for store in self.node.command_stores.all():
             self._maybe_heal_gaps(store)
-            for txn_id in list(store.live_waiters):
+            for txn_id in self._sweep_waiters(store):
                 cmd = store.command_if_present(txn_id)
                 wo = cmd.waiting_on if cmd is not None else None
                 if cmd is None or wo is None or wo.is_done() \
@@ -224,6 +231,28 @@ class ProgressEngine:
                 if not store.current_owned().intersects(participants):
                     continue  # frozen leftover on a lost range
                 self.track(txn_id, participants, cmd.status)
+
+    def _sweep_waiters(self, store) -> list:
+        """The waiter set one sweep walks. The reference path is every
+        entry in the store's live-waiter index; under a recovery-scan mode
+        the cmd arena answers "which rows are live-band AND stalled" first
+        (host shadows or one device query) and the walk visits only
+        candidates still in the index -- plus any waiter the arena has
+        never seen (no row => the scan cannot speak for it)."""
+        if self.recovery_scan is None:
+            return list(store.live_waiters)
+        plane = getattr(store, "cmd_plane", None)
+        if plane is None:
+            return list(store.live_waiters)
+        now = self.node.now_millis()
+        if self.recovery_scan == "device":
+            cand = plane.recovery_scan_device(now, self.stall_ms)
+        else:
+            cand = plane.recovery_scan_host(now, self.stall_ms)
+        waiters = [t for t in cand if t in store.live_waiters]
+        waiters.extend(t for t in store.live_waiters
+                       if t not in plane.row_of)
+        return waiters
 
     def _maybe_heal_gaps(self, store) -> None:
         """A data gap on a CURRENTLY-OWNED range means this replica's copy is

@@ -362,10 +362,20 @@ GLOSSARY: Dict[str, str] = {
     "exec.upload_bytes.full": "wait-graph bytes shipped as all-lane rows",
     "exec.upload_bytes.ts": "wait-graph bytes shipped as exec-ts deltas",
     "exec.upload_bytes.flags": "wait-graph bytes shipped as flag deltas",
+    "exec.dropped_frontiers": "stale-generation frontiers discarded after arena growth",
+    "exec.readback_bytes": "frontier bytes fetched (compact lanes; bitmask only on fallback)",
+    "exec.readback_full_equiv": "what the full packed-bitmask fetch would have cost",
+    "exec.compact_fallbacks": "checksum-mismatch degradations to the bitmask decode",
+    "exec.compact_overflows": "released counts past out_cap (tier bumps, bitmask serves)",
     "exec_coord.dispatches": "fused per-node frontier dispatches",
     "exec_coord.fused_dispatches": "frontier dispatches that fused >1 store",
     "exec_coord.harvest_stall_s": "wall seconds the coordinator blocked on readbacks",
     "exec_coord.prefetched": "coordinator readbacks drained early by the poll",
+    "exec_coord.staged_blocks": "exec harvests staged into fused protocol_tick launches",
+    "exec_coord.readback_bytes": "coordinator frontier bytes fetched (compact lanes)",
+    "exec_coord.readback_full_equiv": "full-bitmask baseline for the coordinator's harvests",
+    "exec_coord.compact_fallbacks": "coordinator checksum degradations to the bitmask decode",
+    "exec_coord.compact_overflows": "coordinator released counts past out_cap",
     # -- device coordination plane (CmdPlane.metrics) ------------------------
     "cmd_plane_dispatches": "batched cmd_tick kernel dispatches",
     "cmd_plane_upload_bytes": "cmd-arena lane bytes shipped host->device",
@@ -377,6 +387,12 @@ GLOSSARY: Dict[str, str] = {
     "cmd_deferred_spans": "PreAccept spans decided by the host twin for the fused tick",
     "cmd_deferred_ops": "protocol ops deferred through the host twin (megakernel mode)",
     "cmd_defer_retired": "host-twinned PreAccept spans folded back through the fused repair stage",
+    "recovery_scan_dispatches": "device recovery-scan queries issued by the progress sweep",
+    "recovery_scan_candidates": "stalled candidate rows returned by verified device scans",
+    "recovery_scan_fallbacks": "recovery scans degraded to the host walk (checksum mismatch)",
+    "recovery_scan_overflows": "recovery scans whose candidate count overflowed out_cap",
+    "recovery_scan_device_s": "wall seconds inside the device recovery query",
+    "recovery_scan_host_s": "wall seconds inside the host-twin recovery walk",
     # -- per-node txn lifecycle (Node.metrics) -------------------------------
     "txn.started": "coordinations started on this node",
     "txn.failed": "coordinations failed (timeout/invalidated)",
@@ -410,6 +426,8 @@ GLOSSARY: Dict[str, str] = {
     "launches_per_tick": "mean device program launches per cluster tick that dispatched",
     "fastpath_quorum_txns": "distinct txns whose PreAccept lanes met the in-kernel fast-path quorum",
     "sharded_megakernel_fallbacks": "megakernel ticks on a mesh that fell back to the unfused sharded pair",
+    "exec_scan_blocks": "exec frontier blocks that rode fused protocol_tick launches",
+    "exec_flush_ticks": "exec-only fused flush ticks (a staged harvest with no protocol work due)",
     # -- device message plane (sim/network.DeviceMessageNetwork
     #    .message_plane_snapshot(), folded into the burn report's counters) ---
     "device_messages_delivered": "deliveries whose payload came from the device mailbox (verified)",

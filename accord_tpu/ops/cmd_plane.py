@@ -38,6 +38,7 @@ pending device run first.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -193,6 +194,16 @@ class CmdPlane:
     deferred_ops = RegCounter("cmd_deferred_ops")
     defer_retired = RegCounter("cmd_defer_retired")
     flush_s = RegTimer("cmd_plane_flush_s")
+    # recovery-candidate scan (kernels.recovery_scan): one device query per
+    # progress sweep instead of the host walk over every live waiter;
+    # checksum mismatch / out_cap overflow fall back to the host twin,
+    # counted (the exec-plane degradation contract)
+    recovery_scan_dispatches = RegCounter("recovery_scan_dispatches")
+    recovery_scan_candidates = RegCounter("recovery_scan_candidates")
+    recovery_scan_fallbacks = RegCounter("recovery_scan_fallbacks")
+    recovery_scan_overflows = RegCounter("recovery_scan_overflows")
+    recovery_scan_device_s = RegTimer("recovery_scan_device_s")
+    recovery_scan_host_s = RegTimer("recovery_scan_host_s")
 
     def __init__(self, store, initial_cap: int = 1024, key_cap: int = 1024,
                  kpad: int = 4, apply_to_store: bool = True,
@@ -217,6 +228,10 @@ class CmdPlane:
 
         self.row_of: Dict[TxnId, int] = {}
         self.kid_of: Dict[object, int] = {}
+        # row -> TxnId reverse map (dense, rows allocate sequentially):
+        # lets the recovery scan translate candidate row lists back to
+        # TxnIds without a per-sweep dict inversion
+        self.tid_by_row: List[TxnId] = []
         self.n_rows = 0
         self.gen = 0
         self._poison: set = set()
@@ -224,6 +239,15 @@ class CmdPlane:
         self._kdirty: set = set()
         self._device = None        # dict of jnp columns once built
         self._device_stale = True  # full rebuild pending
+        # last-arena-touch times (sim ms) feeding the recovery scan's stall
+        # predicate; a separate column OUTSIDE _LANES so the repair block's
+        # 18-array arity is untouched -- flushed only at scan time
+        self.touched_h = np.zeros(cap, np.int32)
+        self._tdirty: set = set()
+        self._touched_dev = None
+        self._touched_stale = True
+        self._tnode = None         # cached store.node handle for _touch
+        self._rec_tiers = None     # OutCapTiers, built on first device scan
 
     # -- shadows <-> store ---------------------------------------------------
 
@@ -250,11 +274,30 @@ class CmdPlane:
                            else np.full(3, _NEG, np.int32)),
             "durability": np.int32(int(cmd.durability)),
         }
+        changed = False
         for name, v in vals.items():
             sh = self._shadow_of(name)
             if not np.array_equal(sh[row], v):
                 sh[row] = v
                 self._dirty[name].add(row)
+                changed = True
+        if changed:
+            self._touch(row)
+
+    def _touch(self, row: int) -> None:
+        """Stamp a row's last-arena-touch time (recovery scan stall ages);
+        a pure sim-clock read, so touching never perturbs determinism.
+        Rides every changed _sync_row, so it stays lean: the node handle is
+        cached on first sight and the stamp is a plain-int store."""
+        node = self._tnode
+        if node is None:
+            node = self._tnode = getattr(self.store, "node", None)
+            if node is None:
+                return
+        now = int(node.now_millis())
+        if self.touched_h[row] != now:
+            self.touched_h[row] = now
+            self._tdirty.add(row)
 
     def on_status(self, cmd) -> None:
         """notify_listeners hook: refresh an EXISTING row from host-side
@@ -300,8 +343,11 @@ class CmdPlane:
         self.ea_h = np.concatenate(
             [self.ea_h, np.full((grow, 3), _NEG, np.int32)])
         self.dur_h = np.concatenate([self.dur_h, np.zeros(grow, np.int32)])
+        self.touched_h = np.concatenate([self.touched_h,
+                                         np.zeros(grow, np.int32)])
         self.cap = cap
         self._device_stale = True
+        self._touched_stale = True
 
     def _row_for(self, txn_id: TxnId) -> int:
         row = self.row_of.get(txn_id)
@@ -312,6 +358,7 @@ class CmdPlane:
         row = self.n_rows
         self.n_rows += 1
         self.row_of[txn_id] = row
+        self.tid_by_row.append(txn_id)
         cmd = self.store.command_if_present(txn_id)
         if cmd is not None:
             # seed clean, then diff: a fresh row starts at the ladder floor,
@@ -368,6 +415,7 @@ class CmdPlane:
                 for name in _LANES:
                     sh = self._shadow_of(name)
                     sh[i] = sh[old]
+                self.touched_h[i] = self.touched_h[old]
                 new_row_of[tid] = i
             n = len(keep)
             self.status_h[n:self.n_rows] = 0
@@ -376,12 +424,16 @@ class CmdPlane:
             self.accepted_h[n:self.n_rows] = _BAL0
             self.ea_h[n:self.n_rows] = _NEG
             self.dur_h[n:self.n_rows] = 0
+            self.touched_h[n:self.n_rows] = 0
             self.row_of = new_row_of
+            self.tid_by_row = [tid for tid, _old in keep]
             self.n_rows = n
             self.gen += 1
             for name in _LANES:
                 self._dirty[name].clear()
+            self._tdirty.clear()
             self._device_stale = True
+            self._touched_stale = True
             self.compactions += 1
 
     # -- admission -----------------------------------------------------------
@@ -476,6 +528,95 @@ class CmdPlane:
             d["kvalid"] = flush_lane(d["kvalid"], kids, self.kvalid_h,
                                      account)
             self._kdirty.clear()
+
+    # -- recovery scan (kernels.recovery_scan) -------------------------------
+
+    def _flush_touched(self) -> None:
+        """Ship the touched column's dirty rows (or rebuild after growth /
+        compaction). Only the scan paths pay for this lane -- it stays off
+        the repair block and the dispatch flush entirely."""
+        import jax.numpy as jnp
+        if self._touched_dev is None or self._touched_stale \
+                or int(self._touched_dev.shape[0]) != self.cap:
+            self._touched_dev = jnp.asarray(self.touched_h)
+            self.upload_bytes += self.touched_h.nbytes
+            self._tdirty.clear()
+            self._touched_stale = False
+        elif self._tdirty:
+            from accord_tpu.ops.deltas import flush_lane
+
+            def account(nbytes: int, _tier: int) -> None:
+                self.upload_bytes += nbytes
+
+            self._touched_dev = flush_lane(self._touched_dev,
+                                           sorted(self._tdirty),
+                                           self.touched_h, account)
+            self._tdirty.clear()
+
+    def _stalled_mask(self, now_ms: int, stall_ms: int) -> np.ndarray:
+        """The scan predicate over the numpy shadows -- bit for bit the
+        fold kernels._recovery_scan_body computes on device: status in the
+        live band (excludes the INVALIDATED/TRUNCATED terminals above
+        APPLIED) and last arena touch at least stall_ms old."""
+        st = self.status_h
+        live = (st >= CMD_ST_PRE_ACCEPTED) & (st < CMD_ST_APPLIED)
+        return live & ((np.int32(now_ms) - self.touched_h)
+                       >= np.int32(stall_ms))
+
+    def recovery_scan_host(self, now_ms: float, stall_ms: float) -> list:
+        """Recovery-candidate TxnIds, row-ascending: the host twin of the
+        device scan and the fallback target for its counted checksum /
+        overflow degradations."""
+        t0 = time.perf_counter()
+        with self._lock:
+            rows = np.nonzero(self._stalled_mask(int(now_ms),
+                                                 int(stall_ms)))[0]
+            out = [self.tid_by_row[r] for r in rows.tolist()]
+        self.recovery_scan_host_s += time.perf_counter() - t0
+        return out
+
+    def recovery_scan_device(self, now_ms: float, stall_ms: float) -> list:
+        """ONE device query answering recovery-candidate selection over the
+        arena columns: compacted row list + checksum, host-verified.
+        Mismatch or out_cap overflow falls back to recovery_scan_host --
+        counted, and bit-identical by construction (the device predicate is
+        the same integer fold over the same flushed columns)."""
+        from accord_tpu.ops.kernels import (RECOVERY_OUT_TIERS,
+                                            frontier_checksum_host,
+                                            recovery_scan)
+        t0 = time.perf_counter()
+        with self._lock:
+            if self._rec_tiers is None:
+                from accord_tpu.ops.tiers import OutCapTiers
+                self._rec_tiers = OutCapTiers(RECOVERY_OUT_TIERS,
+                                              RECOVERY_OUT_TIERS[-1] * 2)
+            est = self._rec_tiers.estimate(1)
+            out_cap = self._rec_tiers.pick(
+                est if est is not None else max(1, self.n_rows // 8))
+            self._flush()
+            self._flush_touched()
+            indptr, rows, csum = recovery_scan(
+                self._device["status"], self._touched_dev,
+                np.int32(int(now_ms)), np.int32(int(stall_ms)),
+                out_cap=out_cap)
+            indptr = np.asarray(indptr)
+            rows = np.asarray(rows)
+            total = int(indptr[-1])
+            self.recovery_scan_dispatches += 1
+            if frontier_checksum_host(indptr, rows) != int(csum):
+                self.recovery_scan_fallbacks += 1
+                self.recovery_scan_device_s += time.perf_counter() - t0
+                return self.recovery_scan_host(now_ms, stall_ms)
+            self._rec_tiers.observe(total, 1)
+            if total > out_cap:
+                self._rec_tiers.overflowed()
+                self.recovery_scan_overflows += 1
+                self.recovery_scan_device_s += time.perf_counter() - t0
+                return self.recovery_scan_host(now_ms, stall_ms)
+            self.recovery_scan_candidates += total
+            out = [self.tid_by_row[r] for r in rows[:total].tolist()]
+        self.recovery_scan_device_s += time.perf_counter() - t0
+        return out
 
     # -- fused repair (the device-messages megakernel path) ------------------
 
@@ -847,11 +988,15 @@ class CmdPlane:
                     "flags": np.int32(new_fl),
                     "promised": np.asarray(new_pr, np.int32),
                     "execute_at": np.asarray(new_ea, np.int32)}
+            changed = False
             for name, v in vals.items():
                 sh = self._shadow_of(name)
                 if not np.array_equal(sh[r], v):
                     sh[r] = v
                     self._dirty[name].add(r)
+                    changed = True
+            if changed:
+                self._touch(r)
             if pa_wit:
                 w_arr = np.asarray(witness, np.int32)
                 for kid in kid_rows[j]:

@@ -54,15 +54,32 @@ class ExecPlane:
     prefetched = RegCounter("exec.prefetched")
     upload_bytes = RegCounter("exec.upload_bytes")
     upload_bytes_full_equiv = RegCounter("exec.upload_bytes_full_equiv")
+    # frontiers dropped on the gen mismatch (compaction raced an in-flight
+    # readback) -- previously swallowed silently
+    dropped_frontiers = RegCounter("exec.dropped_frontiers")
+    # compacted-harvest accounting: bytes the harvest actually fetched vs
+    # what the full-bitmask readback would have cost for the same dispatch
+    # (the PR 4 upload-accounting pattern, readback side)
+    readback_bytes = RegCounter("exec.readback_bytes")
+    readback_full_equiv = RegCounter("exec.readback_full_equiv")
+    compact_fallbacks = RegCounter("exec.compact_fallbacks")
+    compact_overflows = RegCounter("exec.compact_overflows")
 
     def __init__(self, store, initial_cap: int = 1024,
-                 tick_ms: float = 2.0, device_latency_ms: float = 4.0):
+                 tick_ms: float = 2.0, device_latency_ms: float = 4.0,
+                 compact: bool = False):
         self.metrics = MetricsRegistry()
         self.store = store
         self.cap = initial_cap
         self.count = 0
         self.tick_ms = tick_ms
         self.device_latency_ms = device_latency_ms
+        # compacted harvests: the dispatch runs frontier_compact and the
+        # harvest fetches only (indptr, rows, csum) -- O(released) bytes --
+        # with the full bitmask retained on device for the counted
+        # checksum-mismatch / overflow fallbacks
+        self.compact = bool(compact)
+        self._out_tiers = None   # OutCapTiers, built lazily on first pick
         # per-node fused dispatch (ExecCoordinator.register sets this):
         # ticks route to the coordinator, which answers every store's
         # frontier with ONE device call per node tick
@@ -365,10 +382,26 @@ class ExecPlane:
         self._ticking = False
         if not self._needs_dispatch():
             return
-        frontier = self._dispatch()
-        self._inflight.append([frontier, None, self._gen])
+        self._dispatch()   # appends its own in-flight entry
         self.store.node.scheduler.once(self.device_latency_ms, self._harvest)
         self._ensure_poll()
+
+    def _pick_out_cap(self) -> int:
+        """Pin the compaction tier for this dispatch: hysteresis over the
+        device-observed release counts, seeded with the pending-row count
+        (an exact upper bound) while cold."""
+        if self._out_tiers is None:
+            from accord_tpu.ops.kernels import FRONTIER_OUT_TIERS
+            from accord_tpu.ops.tiers import OutCapTiers
+            self._out_tiers = OutCapTiers(FRONTIER_OUT_TIERS,
+                                          FRONTIER_OUT_TIERS[-1] * 2)
+        pend = int(self.pending.sum())
+        est = self._out_tiers.estimate(1)
+        return self._out_tiers.pick(est if est is not None else max(1, pend))
+
+    def _observe_bound(self, total: int) -> None:
+        if self._out_tiers is not None:
+            self._out_tiers.observe(total, 1)
 
     def _ensure_poll(self) -> None:
         """Between dispatch and harvest, a cheap deterministic poll drains
@@ -386,12 +419,7 @@ class ExecPlane:
         q = self._inflight
 
         def prefetch() -> bool:
-            for entry in q:
-                if entry[1] is not None:
-                    continue
-                if not entry[0].is_ready():
-                    break  # single device stream: later calls finish later
-                entry[1] = np.asarray(entry[0])
+            _poll_prefetch(q)
             if q:
                 return True
             self._poll_armed = False
@@ -404,18 +432,28 @@ class ExecPlane:
         + packed adjacency + exec_ts + applied/pending/awaits flags."""
         return m * (4 + self.cap // 8 + 12 + 3)
 
-    def _dispatch(self):
-        """Solo (uncoordinated) launch: sync dirty rows, fire the plain
-        frontier kernel, enqueue its async readback."""
-        from accord_tpu.ops.kernels import execution_frontier
-        out = execution_frontier(*self._sync_device())
-        out.copy_to_host_async()
+    def _dispatch(self) -> None:
+        """Solo (uncoordinated) launch: sync dirty rows, fire the frontier
+        kernel (compacted or legacy bitmask), enqueue its async readback."""
+        from accord_tpu.ops.kernels import (execution_frontier,
+                                            frontier_compact)
+        devs = self._sync_device()
+        if self.compact:
+            out_cap = self._pick_out_cap()
+            res = frontier_compact((tuple(devs),), out_cap=out_cap)
+            for lane in res[:3]:
+                lane.copy_to_host_async()
+            self._inflight.append([res, None, self._gen, out_cap])
+        else:
+            out = execution_frontier(*devs)
+            out.copy_to_host_async()
+            self._inflight.append([out, None, self._gen])
         self.dispatches += 1
         if REC.enabled:
             node = self.store.node
             REC.instant(node_pid(node), "exec", "frontier_dispatch",
-                        node_ts(node), args={"rows": self.count})
-        return out
+                        node_ts(node), args={"rows": self.count,
+                                             "compact": self.compact})
 
     def _sync_device(self):
         """Flush the dirty sets into the device arena and return its lane
@@ -496,30 +534,65 @@ class ExecPlane:
         import time as _time
         if not self._inflight:
             return  # defensive: every dispatch schedules exactly one harvest
-        frontier, packed, gen = self._inflight.popleft()
+        entry = self._inflight.popleft()
+        if len(entry) == 4:   # compacted dispatch
+            res, host, gen, out_cap = entry
+            if host is None:
+                t0 = _time.perf_counter()
+                host = tuple(np.asarray(lane) for lane in res[:3])
+                self.harvest_stall_s += _time.perf_counter() - t0
+            else:
+                self.prefetched += 1
+            w = int(res[3].shape[0])
+            _consume_compact(self, res, host, [(self, (0, w), gen)], out_cap)
+            return
+        frontier, packed, gen = entry
         if packed is None:
             t0 = _time.perf_counter()
             packed = np.asarray(frontier)
             self.harvest_stall_s += _time.perf_counter() - t0
         else:
             self.prefetched += 1
+        self.readback_bytes += packed.nbytes
+        self.readback_full_equiv += packed.nbytes
         self._apply_frontier(packed, gen)
 
+    def _drop_frontier(self, gen: int, rows: int) -> None:
+        """The gen-mismatch drop path: compaction remapped rows while this
+        frontier was in flight; its indices address the old arena -- drop
+        it (the rebuild re-ingested every pending row, so a fresh tick
+        re-covers them). Counted + recorded so compaction races are
+        visible instead of silently swallowed."""
+        self.dropped_frontiers += 1
+        if REC.enabled:
+            node = self.store.node
+            REC.instant(node_pid(node), "exec", "dropped_frontier",
+                        node_ts(node),
+                        args={"gen": gen, "live_gen": self._gen,
+                              "rows": rows})
+        self._schedule_tick()
+
     def _apply_frontier(self, packed: np.ndarray, gen: int) -> None:
-        """Release every frontier row against current host state (the back
-        half of the harvest, shared with the coordinator, which hands each
-        plane its word span of the fused readback)."""
-        from accord_tpu.local import commands as _commands
+        """Legacy bitmask decode (the back half of the harvest, shared with
+        the coordinator, which hands each plane its word span of the fused
+        readback): unpack + nonzero walk, then the shared release loop."""
         if gen != self._gen:
-            # compaction remapped rows while this frontier was in flight;
-            # its indices address the old arena -- drop it (the rebuild
-            # re-ingested every pending row, so a fresh tick re-covers them)
-            self._schedule_tick()
+            self._drop_frontier(gen, -1)
             return
         rows = np.nonzero(
             np.unpackbits(packed.view(np.uint8), bitorder="little"))[0]
+        self._apply_rows(rows.tolist(), gen)
+
+    def _apply_rows(self, rows, gen: int) -> None:
+        """Release every listed arena row against current host state.
+        `rows` arrive ascending -- the exact order the bitmask decode
+        produced -- so compacted and legacy harvests release identically."""
+        from accord_tpu.local import commands as _commands
+        if gen != self._gen:
+            self._drop_frontier(gen, len(rows))
+            return
         store = self.store
-        for row in rows.tolist():
+        for row in rows:
             if row >= self.count or row in self._released \
                     or not self.pending[row]:
                 continue
@@ -539,6 +612,91 @@ class ExecPlane:
             self._schedule_tick()
 
 
+class ExecTicket:
+    """A staged exec block awaiting the engine's next fused protocol_tick.
+    The coordinator holds one in place of a launched frontier_compact
+    result; the cluster engine fulfills `.result` with the block's
+    (indptr, rows, csum, packed) output at its next megakernel launch, or
+    at an exec-only flush tick if the coordinator's harvest comes due
+    first. Purely a host-side rendezvous -- the device computation is the
+    same _frontier_compact_body either way, so fused and standalone
+    harvests release bit-identically."""
+
+    __slots__ = ("planes", "out_cap", "result")
+
+    def __init__(self, planes, out_cap: int):
+        self.planes = planes
+        self.out_cap = out_cap
+        self.result = None
+
+
+def _fetch_compact(res):
+    """Fetch a compacted result's (indptr, rows, csum) host copies; the
+    retained packed bitmask (res[3]) stays on device."""
+    return tuple(np.asarray(lane) for lane in res[:3])
+
+
+def _poll_prefetch(q) -> None:
+    """Drain finished async readbacks into the in-flight entries' host-copy
+    slots via the non-blocking is_ready() probe (shared by the plane and
+    coordinator poll loops). Compact entries fetch only their three
+    compacted lanes; engine tickets wait until the fused launch fulfilled
+    them."""
+    for entry in q:
+        if entry[1] is not None:
+            continue
+        obj = entry[0]
+        if isinstance(obj, ExecTicket):
+            obj = obj.result
+            if obj is None:
+                break   # awaiting the engine's next fused launch
+        if isinstance(obj, tuple):
+            if not all(lane.is_ready() for lane in obj[:3]):
+                break   # single device stream: later calls finish later
+            entry[1] = _fetch_compact(obj)
+            continue
+        if not obj.is_ready():
+            break  # single device stream: later calls finish later
+        entry[1] = np.asarray(obj)
+
+
+def _consume_compact(owner, res, host, entries, out_cap: int) -> None:
+    """Decode one compacted frontier readback and release per plane.
+    `owner` carries the readback counters and out-cap policy (the plane
+    itself on the solo path, the coordinator on the fused one); `entries`
+    is [(plane, (w_lo, w_hi), gen)] with per-plane word spans into the
+    retained packed bitmask, one compaction segment per plane in order."""
+    from accord_tpu.ops.kernels import frontier_checksum_host
+    indptr, rows, csum = host
+    total = int(indptr[-1])
+    full_w = sum(hi - lo for _p, (lo, hi), _g in entries)
+    owner.readback_full_equiv += full_w * 4
+    owner.readback_bytes += indptr.nbytes + rows.nbytes + 4
+    bad = frontier_checksum_host(indptr, rows) != int(csum)
+    if bad or total > out_cap:
+        # a corrupt readback, or more releases than the pinned tier holds
+        # (indptr is exact either way: the overflow bumps straight to a
+        # fitting rung) -- fall back to the legacy decode of the retained
+        # device bitmask. The release set is identical, so chaos and
+        # --reconcile stay bit-identical through the degradation.
+        if bad:
+            owner.compact_fallbacks += 1
+        else:
+            owner.compact_overflows += 1
+            owner._observe_bound(total)
+            if owner._out_tiers is not None:
+                owner._out_tiers.overflowed()
+        packed = np.asarray(res[3])
+        owner.readback_bytes += packed.nbytes
+        for plane, (lo, hi), gen in entries:
+            plane._apply_frontier(packed[lo:hi], gen)
+        return
+    owner._observe_bound(total)
+    for i, (plane, (lo, hi), gen) in enumerate(entries):
+        seg = rows[indptr[i]:indptr[i + 1]] - 32 * lo
+        plane._apply_rows(seg.tolist(), gen)
+
+
 class ExecCoordinator:
     """Per-NODE fusion of the exec planes' frontier calls, mirroring the
     resolver's cross-store fused dispatch: each node tick collects every
@@ -553,22 +711,59 @@ class ExecCoordinator:
     fused_dispatches = RegCounter("exec_coord.fused_dispatches")
     harvest_stall_s = RegTimer("exec_coord.harvest_stall_s")
     prefetched = RegCounter("exec_coord.prefetched")
+    staged_blocks = RegCounter("exec_coord.staged_blocks")
+    readback_bytes = RegCounter("exec_coord.readback_bytes")
+    readback_full_equiv = RegCounter("exec_coord.readback_full_equiv")
+    compact_fallbacks = RegCounter("exec_coord.compact_fallbacks")
+    compact_overflows = RegCounter("exec_coord.compact_overflows")
 
     def __init__(self, node, tick_ms: float = 2.0,
-                 device_latency_ms: float = 4.0):
+                 device_latency_ms: float = 4.0, compact: bool = False):
         self.metrics = MetricsRegistry()
         self.node = node
         self.tick_ms = tick_ms
         self.device_latency_ms = device_latency_ms
+        self.compact = bool(compact)
+        self._out_tiers = None
         self.planes: List[ExecPlane] = []
         self._ticking = False
-        # [fused frontier, host copy or None, [(plane, (lo, hi), gen)]]
+        # [fused frontier | compact result | ExecTicket, host copy or None,
+        #  [(plane, (lo, hi), gen)], out_cap (compact entries only)]
         self._inflight: deque = deque()
         self._poll_armed = False
+
+    def snapshot(self) -> dict:
+        return self.metrics.snapshot()
 
     def register(self, plane: ExecPlane) -> None:
         plane.coordinator = self
         self.planes.append(plane)
+
+    def _engine(self):
+        """The cluster tick engine, when this node rides a megakernel burn
+        with exec fusion enabled: the compact block then STAGES into the
+        engine's next protocol_tick instead of launching standalone, so
+        exec traffic shares the cluster tick's single device call. Resolved
+        lazily per tick -- the engine adopts resolvers after node wiring."""
+        if not self.compact:
+            return None
+        res = getattr(self.node, "_deps_resolver", None)
+        eng = getattr(res, "tick_driver", None) if res is not None else None
+        return eng if getattr(eng, "exec_in_megakernel", False) else None
+
+    def _observe_bound(self, total: int) -> None:
+        if self._out_tiers is not None:
+            self._out_tiers.observe(total, 1)
+
+    def _pick_out_cap(self, parts) -> int:
+        if self._out_tiers is None:
+            from accord_tpu.ops.kernels import FRONTIER_OUT_TIERS
+            from accord_tpu.ops.tiers import OutCapTiers
+            self._out_tiers = OutCapTiers(FRONTIER_OUT_TIERS,
+                                          FRONTIER_OUT_TIERS[-1] * 2)
+        pend = sum(int(p.pending.sum()) for p in parts)
+        est = self._out_tiers.estimate(1)
+        return self._out_tiers.pick(est if est is not None else max(1, pend))
 
     def schedule(self) -> None:
         if self._ticking:
@@ -578,23 +773,44 @@ class ExecCoordinator:
 
     def _tick(self) -> None:
         from accord_tpu.ops.kernels import (execution_frontier,
+                                            frontier_compact,
                                             fused_execution_frontier)
         self._ticking = False
         parts = [p for p in self.planes if p._needs_dispatch()]
         if not parts:
             return
         devs = [p._sync_device() for p in parts]
-        if len(parts) == 1:
-            out = execution_frontier(*devs[0])
-            spans = [(0, parts[0].cap // 32)]
+        spans, off = [], 0
+        for p in parts:
+            spans.append((off, off + p.cap // 32))
+            off += p.cap // 32
+        if self.compact:
+            out_cap = self._pick_out_cap(parts)
+            planes_in = tuple(tuple(d) for d in devs)
+            engine = self._engine()
+            if engine is not None:
+                # ride the cluster tick's single launch: the engine folds
+                # this block into its next fused protocol_tick (or an
+                # exec-only flush tick if our harvest comes due first)
+                out = engine.stage_exec(planes_in, out_cap, self.node)
+                self.staged_blocks += 1
+            else:
+                out = frontier_compact(planes_in, out_cap=out_cap)
+                for lane in out[:3]:
+                    lane.copy_to_host_async()
+            entry = [out, None,
+                     [(p, s, p._gen) for p, s in zip(parts, spans)],
+                     out_cap]
         else:
-            out = fused_execution_frontier(tuple(devs))
-            spans, off = [], 0
-            for p in parts:
-                spans.append((off, off + p.cap // 32))
-                off += p.cap // 32
+            if len(parts) == 1:
+                out = execution_frontier(*devs[0])
+            else:
+                out = fused_execution_frontier(tuple(devs))
+            out.copy_to_host_async()
+            entry = [out, None,
+                     [(p, s, p._gen) for p, s in zip(parts, spans)]]
+        if len(parts) > 1:
             self.fused_dispatches += 1
-        out.copy_to_host_async()
         self.dispatches += 1
         for p in parts:
             p.dispatches += 1
@@ -602,9 +818,9 @@ class ExecCoordinator:
             REC.instant(node_pid(self.node), "exec", "frontier_dispatch",
                         node_ts(self.node),
                         args={"stores": len(parts),
-                              "fused": len(parts) > 1})
-        self._inflight.append(
-            [out, None, [(p, s, p._gen) for p, s in zip(parts, spans)]])
+                              "fused": len(parts) > 1,
+                              "compact": self.compact})
+        self._inflight.append(entry)
         self.node.scheduler.once(self.device_latency_ms, self._harvest)
         self._ensure_poll()
 
@@ -618,12 +834,7 @@ class ExecCoordinator:
         q = self._inflight
 
         def prefetch() -> bool:
-            for entry in q:
-                if entry[1] is not None:
-                    continue
-                if not entry[0].is_ready():
-                    break  # single device stream: later calls finish later
-                entry[1] = np.asarray(entry[0])
+            _poll_prefetch(q)
             if q:
                 return True
             self._poll_armed = False
@@ -635,12 +846,46 @@ class ExecCoordinator:
         import time as _time
         if not self._inflight:
             return  # defensive: every dispatch schedules exactly one harvest
-        frontier, packed, entries = self._inflight.popleft()
+        entry = self._inflight.popleft()
+        if len(entry) == 4:   # compacted dispatch (standalone or staged)
+            obj, host, entries, out_cap = entry
+            res = obj
+            if isinstance(obj, ExecTicket):
+                if obj.result is None:
+                    # no cluster tick fired between our dispatch and this
+                    # harvest: the engine flushes the queued blocks as one
+                    # exec-only fused tick (its launch ledger keeps
+                    # launches_per_tick == 1.0 by construction)
+                    self._engine_flush()
+                res = obj.result
+                if res is None:
+                    # defensive: the engine vanished mid-flight -- run the
+                    # identical block standalone (same body, same result)
+                    from accord_tpu.ops.kernels import frontier_compact
+                    res = obj.result = frontier_compact(
+                        obj.planes, out_cap=out_cap)
+            if host is None:
+                t0 = _time.perf_counter()
+                host = _fetch_compact(res)
+                self.harvest_stall_s += _time.perf_counter() - t0
+            else:
+                self.prefetched += 1
+            _consume_compact(self, res, host, entries, out_cap)
+            return
+        frontier, packed, entries = entry
         if packed is None:
             t0 = _time.perf_counter()
             packed = np.asarray(frontier)
             self.harvest_stall_s += _time.perf_counter() - t0
         else:
             self.prefetched += 1
+        self.readback_bytes += packed.nbytes
+        self.readback_full_equiv += packed.nbytes
         for plane, (lo, hi), gen in entries:
             plane._apply_frontier(packed[lo:hi], gen)
+
+    def _engine_flush(self) -> None:
+        res = getattr(self.node, "_deps_resolver", None)
+        eng = getattr(res, "tick_driver", None) if res is not None else None
+        if eng is not None:
+            eng.flush_exec()

@@ -582,6 +582,117 @@ def csr_checksum_host(indptr, dep_rows, dep_ts) -> int:
     return int(fold(indptr, 1) ^ fold(dep_rows, 5) ^ fold(dep_ts, 9))
 
 
+# --------------------------------------------------------------------------
+# Execution-frontier compaction + recovery scans: the finalized-CSR twins
+# for the exec/recovery planes. The frontier kernel emits the EXACT
+# released-row index list (segment per store), a count bound (indptr), and
+# a u32 integrity word, so harvest readback is O(released) instead of
+# O(arena rows) and the host decode is a direct slice. The recovery scan
+# answers "which cmd-arena rows are live and stalled" the same way, so
+# progress-engine candidate selection at 10k in-flight is one device query.
+
+FRONTIER_OUT_TIERS = (32, 256, 2048)
+RECOVERY_OUT_TIERS = (32, 256, 2048)
+
+
+def frontier_checksum(indptr, rows):
+    """Device integrity word over a compacted frontier (indptr + row list):
+    the exec plane's twin of csr_checksum. Fresh fold seeds so a frontier
+    word can never alias a finalize word; a readback that arrives
+    bit-flipped routes the harvest to the legacy bitmask decode (counted)
+    instead of releasing wrong rows."""
+    return _csum_fold(indptr, 13) ^ _csum_fold(rows, 17)
+
+
+def frontier_checksum_host(indptr, rows) -> int:
+    """numpy twin of frontier_checksum, computed over the fetched host
+    copies. Must track the device fold bit for bit."""
+    def fold(x, seed):
+        v = np.ascontiguousarray(x).view(np.uint32).reshape(-1)
+        v = v ^ (v >> np.uint32(16))
+        idx = np.arange(v.shape[0], dtype=np.uint32)
+        return (v * (np.uint32(2) * idx + np.uint32(seed))).sum(
+            dtype=np.uint32)
+    return int(fold(indptr, 13) ^ fold(rows, 17))
+
+
+def _frontier_compact_body(planes, out_cap: int):
+    """Unjitted body shared by frontier_compact and the protocol_tick exec
+    block (one source of truth -> fused and standalone paths bit-identical).
+    `planes` is a tuple of per-store lane tuples exactly as
+    fused_execution_frontier takes them; each store is one compaction
+    SEGMENT, so indptr demuxes per-store released runs and each row value
+    is a GLOBAL bit index (32 * store word offset + arena row) that the
+    host converts back with its word span."""
+    packs = []
+    for (adj, exec_ts, applied, pending, awaits_all) in planes:
+        cap = adj.shape[0]
+        ready = _frontier_ready(adj, exec_ts, applied, pending, awaits_all)
+        packs.append(_pack_bits(ready.reshape(1, cap))[0])
+    w_tot = sum(int(p.shape[0]) for p in packs)
+    rows_m, off = [], 0
+    for p in packs:
+        w = int(p.shape[0])
+        segs = []
+        if off:
+            segs.append(jnp.zeros(off, jnp.uint32))
+        segs.append(p)
+        if w_tot - off - w:
+            segs.append(jnp.zeros(w_tot - off - w, jnp.uint32))
+        rows_m.append(jnp.concatenate(segs) if len(segs) > 1 else segs[0])
+        off += w
+    m = jnp.stack(rows_m)
+    indptr, rows = _packed_segment_compact(m, out_cap)
+    return (indptr, rows, frontier_checksum(indptr, rows),
+            jnp.concatenate(packs))
+
+
+@functools.partial(jax.jit, static_argnames=("out_cap",))
+def frontier_compact(planes, out_cap: int):
+    """Compacted execution frontier for a tuple of store planes: ONE device
+    call answering every store's release list for a node tick.
+
+    -> (indptr i32[S+1], rows i32[out_cap], csum u32, packed u32[sum(W_s)])
+
+    rows holds released GLOBAL bit indices in (store-major, row-ascending)
+    order; store s's run is rows[indptr[s]:indptr[s+1]] - 32 * w_lo_s.
+    indptr is exact regardless of out_cap: indptr[-1] > out_cap signals
+    overflow AND gives the true needed size for the tier bump. `packed` is
+    the legacy full bitmask, RETAINED ON DEVICE -- the harvest fetches only
+    the compacted lanes (O(released) bytes) and touches packed solely on
+    the counted checksum-mismatch / overflow fallback paths."""
+    return _frontier_compact_body(planes, out_cap)
+
+
+def _recovery_scan_body(status, touched_ms, now_ms, stall_ms, out_cap: int):
+    """Unjitted recovery-candidate scan over cmd-arena SoA columns: a row
+    is a candidate iff its status sits in the live band (PRE_ACCEPTED ..
+    < APPLIED, which also excludes the INVALIDATED/TRUNCATED terminals
+    above it) and its last arena touch is at least stall_ms old. The host
+    twin is CmdPlane.recovery_scan_host -- bit for bit the same predicate
+    over the numpy shadows."""
+    live = (status >= CMD_ST_PRE_ACCEPTED) & (status < CMD_ST_APPLIED)
+    stalled = live & ((now_ms - touched_ms) >= stall_ms)
+    m = _pack_bits(stalled.reshape(1, -1))
+    indptr, rows = _packed_segment_compact(m, out_cap)
+    return indptr, rows, frontier_checksum(indptr, rows)
+
+
+@functools.partial(jax.jit, static_argnames=("out_cap",))
+def recovery_scan(status, touched_ms, now_ms, stall_ms, out_cap: int):
+    """One-query recovery candidate selection: which cmd-arena rows need a
+    MaybeRecover/BeginRecovery probe (reference: the ProgressLog shards'
+    pendingTimers walk, impl/progress/*.java -- batch work for the cmd
+    plane instead of a host walk over every live waiter).
+
+    status/touched_ms: i32[cap] arena columns; now_ms/stall_ms: i32
+    scalars (traced -- value churn mints no recompiles).
+    -> (indptr i32[2], rows i32[out_cap], csum u32); same overflow and
+    checksum contract as frontier_compact."""
+    return _recovery_scan_body(status, touched_ms, now_ms, stall_ms,
+                               out_cap)
+
+
 @functools.partial(jax.jit, static_argnames=("out_cap",))
 def finalize_csr(packed, word_off, kid_rows, slot_subj, slot_kid,
                  subj_row, act_ts, out_cap: int):
@@ -1259,14 +1370,14 @@ def _protocol_tick_fn(statics):
     if fn is not None:
         return fn
     has_key, has_rng, fin_statics, cmd_promotes, qsize, has_mail, \
-        n_repairs = statics
+        n_repairs, exec_statics = statics
     # node_lane imports from this module -- resolve lazily (first call
     # always happens after the engine imported it)
     from accord_tpu.ops import node_lane as _nl
     from accord_tpu.ops.mailbox import _mailbox_route_body
 
     def run(witness_table, key_in, rng_in, fin_in, cmd_in, q_in,
-            mail_in, rep_in):
+            mail_in, rep_in, exec_in):
         packed = ()
         rng_out = ()
         if has_key:
@@ -1310,8 +1421,10 @@ def _protocol_tick_fn(statics):
             mail_out = _mailbox_route_body(*mail_in)
         rep_outs = tuple(_cmd_repair_body(*rep_in[i])
                          for i in range(n_repairs))
+        exec_outs = tuple(_frontier_compact_body(exec_in[i], oc)
+                          for i, oc in enumerate(exec_statics))
         return (packed, rng_out, tuple(fin_outs), tuple(cmd_outs), q_out,
-                mail_out, rep_outs)
+                mail_out, rep_outs, exec_outs)
 
     fn = jax.jit(run)
     _PROTOCOL_TICK_FNS[statics] = fn
@@ -1320,7 +1433,7 @@ def _protocol_tick_fn(statics):
 
 def protocol_tick(witness_table, key_in=None, rng_in=None, fins=(),
                   cmds=(), quorum=None, quorum_size=1, mailbox=None,
-                  cmd_repairs=()):
+                  cmd_repairs=(), execs=()):
     """Launch the fused cluster-tick program: ONE device dispatch covering
     deps resolve, finalize compaction, cmd transitions, the fast-path
     quorum count, the device-message mailbox routing stage, and any
@@ -1348,26 +1461,36 @@ def protocol_tick(witness_table, key_in=None, rng_in=None, fins=(),
              stage, or None.
     cmd_repairs: CmdPlane.collect_repair blocks (18 arrays each, see
              _cmd_repair_body) retiring deferred-twin flush debt in-kernel.
+    execs:   execution-frontier compaction blocks, one per ExecCoordinator
+             staging this tick: (planes, out_cap) where planes is the
+             fused_execution_frontier lane-tuple tuple and out_cap the
+             compaction tier (static). Outputs follow frontier_compact's
+             contract (indptr, rows, csum, packed).
     -> (packed, (rpacked, kpacked), fin_outs, cmd_outs,
-        (fast, votes, met), mail_out, rep_outs); absent stages return ().
+        (fast, votes, met), mail_out, rep_outs, exec_outs); absent stages
+        return ().
     """
     fin_statics, fin_traced, order = _fin_split(fins)
     cmd_statics = tuple(bool(c[-1]) for c in cmds)
     cmd_traced = tuple(tuple(c[:-1]) for c in cmds)
+    exec_statics = tuple(int(oc) for (_pl, oc) in execs)
+    exec_traced = tuple(tuple(tuple(p) for p in pl) for (pl, _oc) in execs)
     statics = (key_in is not None, rng_in is not None, tuple(fin_statics),
                cmd_statics, int(quorum_size) if quorum is not None else None,
-               mailbox is not None, len(cmd_repairs))
+               mailbox is not None, len(cmd_repairs), exec_statics)
     fn = _protocol_tick_fn(statics)
-    packed, rng_out, fin_outs, cmd_outs, q_out, mail_out, rep_outs = fn(
+    (packed, rng_out, fin_outs, cmd_outs, q_out, mail_out, rep_outs,
+     exec_outs) = fn(
         witness_table,
         tuple(key_in) if key_in is not None else (),
         tuple(rng_in) if rng_in is not None else (),
         tuple(fin_traced), cmd_traced,
         tuple(quorum) if quorum is not None else (),
         tuple(mailbox) if mailbox is not None else (),
-        tuple(tuple(r) for r in cmd_repairs))
+        tuple(tuple(r) for r in cmd_repairs),
+        exec_traced)
     return (packed, rng_out, _fin_unsort(fin_outs, order), cmd_outs,
-            q_out, mail_out, rep_outs)
+            q_out, mail_out, rep_outs, exec_outs)
 
 
 def _fin_split(fins):
@@ -1426,6 +1549,8 @@ def jit_cache_sizes() -> dict:
         "range_finalize_csr": range_finalize_csr._cache_size(),
         "kid_word_scatter": kid_word_scatter._cache_size(),
         "fused_execution_frontier": fused_execution_frontier._cache_size(),
+        "frontier_compact": frontier_compact._cache_size(),
+        "recovery_scan": recovery_scan._cache_size(),
         "cmd_tick": cmd_tick._cache_size(),
         "protocol_tick": protocol_tick_cache_sizes(),
         # node-lane (cluster-on-mesh burn) kernels live in ops/node_lane,

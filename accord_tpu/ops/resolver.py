@@ -133,7 +133,8 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
            cmd_kpad: int = 4, cmd_op_tiers=None,
            cmd_promote_modes=(False,),
            node_tiers=(), node_batch_tiers=None,
-           mega_quorum_sizes=(), mega_lane_tiers=None) -> None:
+           mega_quorum_sizes=(), mega_lane_tiers=None,
+           exec_tiers=(), recovery_tiers=()) -> None:
     """Pre-compile the jit shape tiers the async pipeline uses (first
     compilation costs seconds on a tunnelled TPU; production would do the
     same at process start). The jit cache is process-global, so one call
@@ -176,7 +177,13 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
     (kernels.protocol_tick) across `mega_lane_tiers` (default: the first
     MEGA_LANE_TIERS rungs) for each electorate majority in use; the full
     fused programs key on per-tick finalize signatures and warm on the
-    bench's dedicated warm pass instead."""
+    bench's dedicated warm pass instead. `exec_tiers` (opt-in) warms the
+    compacted execution-frontier harvest (kernels.frontier_compact) across
+    (exec cap x plane count x out_cap) -- plane counts follow `store_tiers`
+    plus the solo plane -- and the engine's exec-only fused flush
+    (protocol_tick with only exec blocks), so OutCapTiers cap churn mints
+    zero recompiles. `recovery_tiers` likewise warms kernels.recovery_scan
+    across every (cmd arena cap x out_cap) the progress sweeps query."""
     import jax.numpy as jnp
     from accord_tpu.ops.kernels import (NNZ_TIERS, SCATTER_NNZ_TIERS,
                                         arena_scatter, arena_scatter_keys,
@@ -332,6 +339,28 @@ def warmup(num_buckets: int = 1024, cap: int = 8192,
                             jnp.zeros(t, jnp.int32),
                             jnp.zeros(t, bool)),
                     quorum_size=qs)[4][2]
+    if exec_tiers:
+        from accord_tpu.ops.kernels import frontier_compact, protocol_tick
+        for ecap in (tuple(exec_caps) or (1024,)):
+            plane = (jnp.zeros((ecap, ecap), bool),
+                     jnp.full((ecap, 3), neg, jnp.int32),
+                     jnp.zeros(ecap, bool), jnp.zeros(ecap, bool),
+                     jnp.zeros(ecap, bool))
+            counts = (1,) + tuple(s for s in store_tiers if s > 1)
+            for n in counts:
+                planes = tuple(plane for _ in range(n))
+                for oc in exec_tiers:
+                    out = frontier_compact(planes, out_cap=oc)[0]
+                    out = protocol_tick(table,
+                                        execs=((planes, oc),))[7][0][0]
+    if recovery_tiers:
+        from accord_tpu.ops.kernels import recovery_scan
+        for ccap in (tuple(cmd_caps) or (1024,)):
+            st = jnp.zeros(ccap, jnp.int32)
+            tm = jnp.zeros(ccap, jnp.int32)
+            for oc in recovery_tiers:
+                out = recovery_scan(st, tm, np.int32(0), np.int32(0),
+                                    out_cap=oc)[0]
     if out is not None:
         import jax
         jax.block_until_ready(out)

@@ -692,7 +692,7 @@ def _sharded_tick_fn(mesh: Mesh, statics):
     if fn is not None:
         return fn
     has_key, has_rng, fin_statics, cmd_promotes, qsize, has_mail, \
-        n_repairs = statics
+        n_repairs, exec_statics = statics
     from accord_tpu.ops import kernels as _k
     from accord_tpu.ops.mailbox import _sharded_mailbox_route_part
     data = mesh.shape["data"]
@@ -708,7 +708,7 @@ def _sharded_tick_fn(mesh: Mesh, statics):
             else jnp.concatenate(blocks, axis=1)
 
     def run(witness_table, key_in, rng_in, fin_in, cmd_in, q_in,
-            mail_in, rep_in):
+            mail_in, rep_in, exec_in):
         packed = ()
         rng_out = ()
         if has_key:
@@ -805,8 +805,13 @@ def _sharded_tick_fn(mesh: Mesh, statics):
             )(*mail_in)
         rep_outs = tuple(_k._cmd_repair_body(*rep_in[i])
                          for i in range(n_repairs))
+        # exec arenas are host-owned replicated lanes (like cmd/quorum):
+        # the frontier compaction runs as a plain body beside the sharded
+        # stages -- same source of truth as the single-device exec block
+        exec_outs = tuple(_k._frontier_compact_body(exec_in[i], oc)
+                          for i, oc in enumerate(exec_statics))
         return (packed, rng_out, tuple(fin_outs), tuple(cmd_outs), q_out,
-                mail_out, rep_outs)
+                mail_out, rep_outs, exec_outs)
 
     fn = jax.jit(run)
     _SHARDED_TICK_FNS[key] = fn
@@ -815,7 +820,8 @@ def _sharded_tick_fn(mesh: Mesh, statics):
 
 def sharded_protocol_tick(mesh: Mesh, witness_table, key_in=None,
                           rng_in=None, fins=(), cmds=(), quorum=None,
-                          quorum_size=1, mailbox=None, cmd_repairs=()):
+                          quorum_size=1, mailbox=None, cmd_repairs=(),
+                          execs=()):
     """Multi-chip twin of ops.kernels.protocol_tick: ONE fused mesh
     program per cluster tick. Same argument contract (see protocol_tick's
     docstring) with `mesh` prepended; key_in/rng_in are the node-lane
@@ -830,20 +836,24 @@ def sharded_protocol_tick(mesh: Mesh, witness_table, key_in=None,
     fin_statics, fin_traced, order = _fin_split(fins)
     cmd_statics = tuple(bool(c[-1]) for c in cmds)
     cmd_traced = tuple(tuple(c[:-1]) for c in cmds)
+    exec_statics = tuple(int(oc) for (_pl, oc) in execs)
+    exec_traced = tuple(tuple(tuple(p) for p in pl) for (pl, _oc) in execs)
     statics = (key_in is not None, rng_in is not None, tuple(fin_statics),
                cmd_statics, int(quorum_size) if quorum is not None else None,
-               mailbox is not None, len(cmd_repairs))
+               mailbox is not None, len(cmd_repairs), exec_statics)
     fn = _sharded_tick_fn(mesh, statics)
-    packed, rng_out, fin_outs, cmd_outs, q_out, mail_out, rep_outs = fn(
+    (packed, rng_out, fin_outs, cmd_outs, q_out, mail_out, rep_outs,
+     exec_outs) = fn(
         witness_table,
         tuple(key_in) if key_in is not None else (),
         tuple(rng_in) if rng_in is not None else (),
         tuple(fin_traced), cmd_traced,
         tuple(quorum) if quorum is not None else (),
         tuple(mailbox) if mailbox is not None else (),
-        tuple(tuple(r) for r in cmd_repairs))
+        tuple(tuple(r) for r in cmd_repairs),
+        exec_traced)
     return (packed, rng_out, _fin_unsort(fin_outs, order), cmd_outs,
-            q_out, mail_out, rep_outs)
+            q_out, mail_out, rep_outs, exec_outs)
 
 
 def sharded_protocol_tick_cache_sizes() -> int:
@@ -867,7 +877,10 @@ def warmup_sharded(mesh: Mesh, num_buckets: int = 256, cap: int = 4096,
                    node_tiers: Tuple[int, ...] = (),
                    node_batch_tiers: Optional[Tuple[int, ...]] = None,
                    mega_quorum_sizes: Tuple[int, ...] = (),
-                   mega_lane_tiers: Optional[Tuple[int, ...]] = None) -> None:
+                   mega_lane_tiers: Optional[Tuple[int, ...]] = None,
+                   exec_caps: Tuple[int, ...] = (),
+                   exec_tiers: Tuple[int, ...] = (),
+                   recovery_tiers: Tuple[int, ...] = ()) -> None:
     """Pre-compile the sharded hot kernels' (batch tier, nnz tier, store
     tier) jit cross product (the sharded twin of ops.resolver.warmup; same
     padding ladders the overlapped pipeline dispatches). Store tiers >= 2
@@ -888,7 +901,12 @@ def warmup_sharded(mesh: Mesh, num_buckets: int = 256, cap: int = 4096,
     ops.resolver.warmup's node_tiers. `mega_quorum_sizes` (opt-in) warms
     the sharded protocol megakernel's quorum-count stage across the lane
     tiers a megakernel burn pads PreAccept spans to -- the sharded twin of
-    resolver.warmup's mega block."""
+    resolver.warmup's mega block. `exec_tiers` / `recovery_tiers` (opt-in)
+    warm the compacted exec-frontier and recovery-scan blocks through the
+    sharded megakernel's exec-only variant (the exec arenas are host-owned
+    replicated lanes, so the bodies match the single-device kernels bit for
+    bit) across (`exec_caps` x plane count x out_cap) and (`cmd_caps` x
+    out_cap) respectively."""
     from accord_tpu.ops.encoding import WITNESS_TABLE
     from accord_tpu.ops.kernels import NNZ_TIERS
     if nnz_tiers is None:
@@ -993,6 +1011,33 @@ def warmup_sharded(mesh: Mesh, num_buckets: int = 256, cap: int = 4096,
                             jnp.zeros(t, jnp.int32),
                             jnp.zeros(t, bool)),
                     quorum_size=qs)[4][2]
+    if exec_tiers:
+        from accord_tpu.ops.kernels import frontier_compact
+        neg = np.iinfo(np.int32).min
+        for ecap in (tuple(exec_caps) or (1024,)):
+            plane = (jnp.zeros((ecap, ecap), bool),
+                     jnp.full((ecap, 3), neg, jnp.int32),
+                     jnp.zeros(ecap, bool), jnp.zeros(ecap, bool),
+                     jnp.zeros(ecap, bool))
+            counts = (1,) + tuple(s for s in store_tiers if s > 1)
+            for n in counts:
+                planes = tuple(plane for _ in range(n))
+                for oc in exec_tiers:
+                    # both homes: the standalone coordinator dispatch and
+                    # the engine's exec-only fused flush on this mesh
+                    out = frontier_compact(planes, out_cap=oc)[0]
+                    out = sharded_protocol_tick(
+                        mesh, table, execs=((planes, oc),))[7][0][0]
+    if recovery_tiers:
+        # the cmd arena is store-local and replicated: sharded deployments
+        # dispatch the same single-device recovery_scan
+        from accord_tpu.ops.kernels import recovery_scan
+        for ccap in (tuple(cmd_caps) or (1024,)):
+            st = jnp.zeros(ccap, jnp.int32)
+            tm = jnp.zeros(ccap, jnp.int32)
+            for oc in recovery_tiers:
+                out = recovery_scan(st, tm, np.int32(0), np.int32(0),
+                                    out_cap=oc)[0]
     if out is not None:
         jax.block_until_ready(out)
 

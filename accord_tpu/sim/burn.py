@@ -339,10 +339,19 @@ def run_burn(seed: int, ops: int = 1000, *, nodes: int = 3, rf: int = 3,
     # fallbacks) in beside the engine counters so burn JSON carries them
     for node in cluster.nodes.values():
         for store in node.command_stores.all():
-            if store.cmd_plane is not None:
-                for k, v in store.cmd_plane.snapshot().items():
-                    if isinstance(v, (int, float)):
-                        report.counters[k] = report.counters.get(k, 0) + v
+            for plane in (store.cmd_plane,
+                          getattr(store, "exec_plane", None)):
+                if plane is not None:
+                    for k, v in plane.snapshot().items():
+                        if isinstance(v, (int, float)):
+                            report.counters[k] = \
+                                report.counters.get(k, 0) + v
+    # per-node exec coordinators (fused frontier dispatch) fold in beside
+    # their planes' counters
+    for coord in getattr(cluster, "exec_coordinators", {}).values():
+        for k, v in coord.snapshot().items():
+            if isinstance(v, (int, float)):
+                report.counters[k] = report.counters.get(k, 0) + v
     # device message plane counters (empty dict on the host baseline)
     for k, v in cluster.network.message_plane_snapshot().items():
         report.counters[k] = v

@@ -36,7 +36,8 @@ class ClusterConfig:
                  durability: bool = False, durability_interval_ms: float = 500.0,
                  preaccept_timeout_ms: float = 1000.0,
                  exec_plane: bool = False, exec_tick_ms: float = 2.0,
-                 exec_fuse: bool = True,
+                 exec_fuse: bool = True, exec_compact: bool = False,
+                 recovery_scan=None,
                  cmd_plane: bool = False, cmd_plane_cap: int = 1024,
                  cmd_plane_key_cap: int = 1024,
                  cmd_plane_authoritative: bool = False,
@@ -84,6 +85,15 @@ class ClusterConfig:
         # fuse the exec planes' per-store frontier calls into one per-node
         # dispatch (ExecCoordinator); solo planes keep the plain kernel
         self.exec_fuse = exec_fuse
+        # compacted frontier readback (frontier_compact): harvest the exact
+        # released-row index list + checksum instead of the full bitmask;
+        # checksum mismatch falls back to the legacy decode, counted
+        self.exec_compact = exec_compact
+        # recovery candidate selection mode for ProgressEngine sweeps:
+        # None = per-entry host walk (the reference path), "host" = the
+        # scan predicate evaluated on the cmd-arena host shadows, "device"
+        # = one recovery_scan device query per sweep (host-verified)
+        self.recovery_scan = recovery_scan
         # device command arena (ops/cmd_plane.py): batch-evaluate PreAccept
         # witnesses, Accept ballot checks and Commit/Apply promotions in one
         # cmd_tick dispatch per drain, host handlers as residuals. False =
@@ -303,7 +313,8 @@ class Cluster:
                 interval_ms=self.config.progress_interval_ms,
                 stall_ms=self.config.progress_stall_ms,
                 home_defer=self.config.progress_home_defer,
-                inform_home=self.config.progress_inform_home)
+                inform_home=self.config.progress_inform_home,
+                recovery_scan=self.config.recovery_scan)
             progress_factory = engine.log_for
         time_service = self.time_service
         if self.config.clock_drift:
@@ -349,12 +360,14 @@ class Cluster:
             if self.config.exec_fuse and self.config.stores_per_node > 1:
                 coordinator = ExecCoordinator(
                     node, tick_ms=self.config.exec_tick_ms,
-                    device_latency_ms=self.config.device_latency_ms)
+                    device_latency_ms=self.config.device_latency_ms,
+                    compact=self.config.exec_compact)
                 self.exec_coordinators[node_id] = coordinator
             for store in node.command_stores.all():
                 store.exec_plane = ExecPlane(
                     store, tick_ms=self.config.exec_tick_ms,
-                    device_latency_ms=self.config.device_latency_ms)
+                    device_latency_ms=self.config.device_latency_ms,
+                    compact=self.config.exec_compact)
                 if coordinator is not None:
                     coordinator.register(store.exec_plane)
         if self.config.cmd_plane:

@@ -81,13 +81,23 @@ class ClusterTickEngine:
     is exactly the baseline's NodeScheduler-guard semantics."""
 
     def __init__(self, mesh_tick: bool = True, megakernel: bool = False,
-                 device_messages: bool = False):
+                 device_messages: bool = False,
+                 exec_in_megakernel: bool = False):
         self.mesh_tick = mesh_tick
         # megakernel rides the mesh_tick staging (it consumes the same
         # recorded plan args); cmd spans defer to the host twin so their
         # transition lanes can join the fused program's quorum stage
         self.megakernel = megakernel and mesh_tick
         self.cmd_defer = self.megakernel
+        # exec planes join the megakernel: ExecCoordinator compact blocks
+        # stage here (stage_exec) and ride the next fused protocol_tick;
+        # a harvest coming due with no cluster tick in between flushes the
+        # queued blocks as one exec-only fused tick (flush_exec), so
+        # launches_per_tick holds 1.0 with exec traffic included
+        self.exec_in_megakernel = exec_in_megakernel and self.megakernel
+        self._exec_blocks: List = []
+        self._exec_wtable = None     # witness table for exec-only flushes
+        self._exec_mesh = None       # sharded resolver's mesh, if any
         # device message plane: replica payloads ride the mailbox routing
         # stage of the same fused program (requires the megakernel; the
         # DeviceMessageNetwork batches deliveries either way)
@@ -110,6 +120,8 @@ class ClusterTickEngine:
         self.megakernel_dispatches = 0
         self.sharded_megakernel_fallbacks = 0
         self.fastpath_quorum_txns = 0
+        self.exec_scan_blocks = 0
+        self.exec_flush_ticks = 0
         # per-plan deferred kernel calls staged this run -- in loop mode
         # each is one device dispatch; in mesh mode they collapse into
         # node_lane_dispatches (bench reads this attribute directly; it
@@ -159,7 +171,62 @@ class ClusterTickEngine:
             "sharded_megakernel_fallbacks": self.sharded_megakernel_fallbacks,
             "launches_per_tick": (self.protocol_launches / t) if t else 0.0,
             "fastpath_quorum_txns": self.fastpath_quorum_txns,
+            "exec_scan_blocks": self.exec_scan_blocks,
+            "exec_flush_ticks": self.exec_flush_ticks,
         }
+
+    # -- exec-plane hooks (ops/exec_plane.ExecCoordinator) -----------------
+    def stage_exec(self, planes, out_cap: int, node):
+        """An ExecCoordinator's compacted frontier block, staged to ride
+        the next fused protocol_tick. Returns an ExecTicket the coordinator
+        holds in place of a launched result; the block's device compute is
+        the same _frontier_compact_body either way, so WHERE it launches is
+        invisible to the simulation (no scheduler events, no rng draws --
+        histories stay bit-identical to the standalone coordinator)."""
+        from accord_tpu.ops.exec_plane import ExecTicket
+        if self._exec_wtable is None:
+            res = getattr(node, "_deps_resolver", None)
+            self._exec_wtable = getattr(res, "_table", None)
+            self._exec_mesh = getattr(res, "mesh", None)
+        ticket = ExecTicket(planes, out_cap)
+        self._exec_blocks.append(ticket)
+        return ticket
+
+    def _pop_exec_tickets(self):
+        if not (self.exec_in_megakernel and self._exec_blocks):
+            return ()
+        tickets, self._exec_blocks = tuple(self._exec_blocks), []
+        return tickets
+
+    def _fulfill_exec(self, tickets, exec_outs) -> None:
+        for t, out in zip(tickets, exec_outs):
+            t.result = out
+            for lane in out[:3]:
+                lane.copy_to_host_async()
+        self.exec_scan_blocks += len(tickets)
+
+    def flush_exec(self) -> None:
+        """Launch every queued exec block as ONE exec-only fused tick: the
+        coordinator's harvest came due before any cluster tick fired. The
+        flush is its own tick in the launch ledger (one launch, one tick
+        with dispatch), so launches_per_tick == 1.0 holds by construction
+        even on exec-dominated idle tails."""
+        tickets = self._pop_exec_tickets()
+        if not tickets:
+            return
+        execs = tuple((t.planes, t.out_cap) for t in tickets)
+        if self._exec_mesh is not None:
+            from accord_tpu.parallel.mesh import sharded_protocol_tick
+            exec_outs = sharded_protocol_tick(
+                self._exec_mesh, self._exec_wtable, execs=execs)[7]
+        else:
+            from accord_tpu.ops.kernels import protocol_tick
+            exec_outs = protocol_tick(self._exec_wtable, execs=execs)[7]
+        self._fulfill_exec(tickets, exec_outs)
+        self.exec_flush_ticks += 1
+        self.megakernel_dispatches += 1
+        self.protocol_launches += 1
+        self._ticks_with_dispatch += 1
 
     # -- cmd-plane hooks (resolver._drain_and_preaccept) -------------------
     def note_cmd_dispatches(self, n: int) -> None:
@@ -526,18 +593,21 @@ class ClusterTickEngine:
         rep_blocks, rep_adopts = ((), ())
         if self.device_messages:
             rep_blocks, rep_adopts = self._collect_cmd_repairs()
+        exec_tickets = self._pop_exec_tickets()
+        execs = tuple((t.planes, t.out_cap) for t in exec_tickets)
         if km is not None or rm is not None or fins or quorum is not None \
-                or mail is not None or rep_blocks:
+                or mail is not None or rep_blocks or execs:
             (packed_out, rng_out, fin_outs, _cmd, q_out, mail_out,
-             rep_outs) = tick(
+             rep_outs, exec_outs) = tick(
                 res0._table, key_in=key_in, rng_in=rng_in,
                 fins=tuple(fins), quorum=quorum,
                 quorum_size=self.quorum_size, mailbox=mail,
-                cmd_repairs=rep_blocks)
+                cmd_repairs=rep_blocks, execs=execs)
             if mail is not None:
                 self._net.mailbox_adopt(mail_out)
             for (plane, meta, spans), outs in zip(rep_adopts, rep_outs):
                 plane.adopt_repair(outs, meta, spans)
+            self._fulfill_exec(exec_tickets, exec_outs)
             self.megakernel_dispatches += 1
             self.protocol_launches += 1
             if km is not None or rm is not None:
@@ -601,6 +671,11 @@ def run_mesh_burn(seed: int, ops: int = 500, *, nodes: int = 8,
                   device_latency_ms: float = 4.0,
                   num_buckets: int = 128,
                   pad_node_tiers=None,
+                  exec_plane: bool = False,
+                  exec_compact: bool = False,
+                  exec_in_megakernel: bool = False,
+                  exec_tick_ms: float = 2.0,
+                  recovery_scan=None,
                   cmd_plane: bool = False,
                   cmd_plane_authoritative: bool = False,
                   resolver_kwargs: Optional[dict] = None,
@@ -621,7 +696,8 @@ def run_mesh_burn(seed: int, ops: int = 500, *, nodes: int = 8,
 
     eng = engine or ClusterTickEngine(mesh_tick=mesh_tick,
                                       megakernel=megakernel,
-                                      device_messages=device_messages)
+                                      device_messages=device_messages,
+                                      exec_in_megakernel=exec_in_megakernel)
     eng.quorum_size = min(rf, nodes) // 2 + 1
     rkw = dict(resolver_kwargs or {})
     rkw.setdefault("num_buckets", num_buckets)
@@ -645,6 +721,9 @@ def run_mesh_burn(seed: int, ops: int = 500, *, nodes: int = 8,
         deps_resolver_factory=factory,
         deps_batch_window_ms=batch_window_ms,
         device_latency_ms=device_latency_ms,
+        exec_plane=exec_plane, exec_tick_ms=exec_tick_ms,
+        exec_compact=exec_compact,
+        recovery_scan=recovery_scan,
         cmd_plane=cmd_plane,
         cmd_plane_authoritative=cmd_plane_authoritative,
         device_messages=device_messages,
@@ -675,6 +754,18 @@ def main(argv=None) -> int:
     ap.add_argument("--crash-restart", action="store_true")
     ap.add_argument("--cmd-plane", action="store_true")
     ap.add_argument("--cmd-plane-authoritative", action="store_true")
+    ap.add_argument("--exec-plane", action="store_true",
+                    help="device execution frontier scheduler")
+    ap.add_argument("--exec-compact", action="store_true",
+                    help="compacted frontier readback (implies --exec-plane)")
+    ap.add_argument("--exec-in-megakernel", action="store_true",
+                    help="stage exec frontier blocks into the fused "
+                         "protocol_tick (implies --exec-compact + "
+                         "--megakernel)")
+    ap.add_argument("--recovery-scan", choices=["host", "device"],
+                    default=None,
+                    help="progress-sweep candidate selection through the "
+                         "cmd-arena scan (host twin or device query)")
     ap.add_argument("--python-loop", action="store_true",
                     help="per-node launch loop (the differential baseline)")
     ap.add_argument("--sharded", action="store_true",
@@ -702,8 +793,14 @@ def main(argv=None) -> int:
             cmd_plane_authoritative=args.cmd_plane_authoritative,
             mesh_tick=not args.python_loop,
             sharded=args.sharded,
-            megakernel=args.megakernel or args.device_messages,
-            device_messages=args.device_messages)
+            megakernel=(args.megakernel or args.device_messages
+                        or args.exec_in_megakernel),
+            device_messages=args.device_messages,
+            exec_plane=(args.exec_plane or args.exec_compact
+                        or args.exec_in_megakernel),
+            exec_compact=args.exec_compact or args.exec_in_megakernel,
+            exec_in_megakernel=args.exec_in_megakernel,
+            recovery_scan=args.recovery_scan)
         try:
             r, eng = run_mesh_burn(seed, collect_log=args.reconcile,
                                    **kwargs)

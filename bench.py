@@ -1927,6 +1927,342 @@ def bench_message_plane(quick: bool):
 
 
 # ---------------------------------------------------------------------------
+# recovery storm: device-compacted frontier + recovery scans at 10k in-flight
+# ---------------------------------------------------------------------------
+
+def bench_recovery_storm(quick: bool):
+    """The exec/recovery plane's device-compaction contract, three legs.
+
+    STORM BURN: same-seed crash-restart burns (cmd arena on), recovery
+    candidate selection via the host walk vs ONE kernels.recovery_scan
+    device query per progress sweep feeding _sweep_stuck_waiters. Gates:
+    bit-identical event logs, device dispatches fired, zero counted
+    checksum fallbacks / out_cap overflows. An exec twin rides along:
+    standalone compacted ExecPlane harvest vs the frontier block staged
+    INTO the megakernel (exec_in_megakernel=True) -- bit-identical logs
+    and launches_per_tick exactly 1.0 with exec traffic included.
+
+    EXEC READBACK @10k: frontier_compact over 5 x 2048-row wait-graph
+    arenas (10240 in-flight waiters) through the real _consume_compact
+    accounting. Gate: compacted readback bytes (indptr + row list + csum)
+    STRICTLY below the full packed-bitmask equivalent. (Burn-scale arenas
+    stay at 1024 rows where the padded out_cap row list can exceed the
+    tiny full bitmask -- the win is an in-flight-scale property, so it is
+    gated here and only reported for the burns.)
+
+    SCAN @10k: real PreAccept/Commit/Apply streams park ~10k rows in one
+    CmdPlane (a third driven to APPLIED -- terminals must be excluded),
+    stall ages synthesized, then the timed window compares the pure-python
+    host walk and the numpy shadow twin against the device query. Gates:
+    candidate lists bit-identical on every scan, one device dispatch per
+    scan, zero fallbacks/overflows, and zero compiles minted in the timed
+    window across the FULL jit_cache_sizes surface (the recovery_tiers=
+    warmup pass-through plus an organic warm sweep cover the tier
+    ladder). Host-walk vs device-query wall time is reported un-gated:
+    on CPU a dispatch is a function call, so the portable number is the
+    readback/launch structure, not the wall ratio."""
+    import random as _random
+
+    from accord_tpu.ops.cmd_plane import CmdOp, CmdPlane
+    from accord_tpu.ops.exec_plane import _consume_compact
+    from accord_tpu.ops.kernels import (CMD_ST_APPLIED, CMD_ST_PRE_ACCEPTED,
+                                        FRONTIER_OUT_TIERS,
+                                        RECOVERY_OUT_TIERS, frontier_compact,
+                                        jit_cache_sizes)
+    from accord_tpu.ops.resolver import warmup
+    from accord_tpu.ops.tiers import OutCapTiers
+    from accord_tpu.primitives.deps import Deps
+    from accord_tpu.primitives.keyspace import Keys
+    from accord_tpu.primitives.timestamp import TxnKind
+    from accord_tpu.primitives.txn import Txn
+    from accord_tpu.sim.cluster import Cluster, ClusterConfig
+    from accord_tpu.sim.list_store import ListQuery, ListRead, ListUpdate
+    from accord_tpu.sim.mesh_burn import run_mesh_burn
+    import jax.numpy as jnp
+
+    # -- leg 1: crash-restart storm burn, host walk vs device scan ----------
+    storm_kw = dict(ops=24 if quick else 48, nodes=4, rf=3,
+                    stores_per_node=2, key_count=24, concurrency=8,
+                    collect_log=True, cmd_plane=True, crash_restart=True,
+                    megakernel=True)
+    rh, _ = run_mesh_burn(17, recovery_scan="host", **storm_kw)
+    rd, _ = run_mesh_burn(17, recovery_scan="device", **storm_kw)
+    if rh.log != rd.log:
+        raise AssertionError(
+            f"device recovery scan diverged from the host walk "
+            f"({len(rh.log)} vs {len(rd.log)} entries)")
+    if rd.counters.get("recovery_scan_dispatches", 0) <= 0:
+        raise AssertionError("storm burn issued no recovery_scan dispatches")
+    if rd.counters.get("recovery_scan_fallbacks", 0) \
+            or rd.counters.get("recovery_scan_overflows", 0):
+        raise AssertionError(
+            f"storm burn degraded: "
+            f"{rd.counters.get('recovery_scan_fallbacks', 0)} checksum "
+            f"fallbacks, {rd.counters.get('recovery_scan_overflows', 0)} "
+            f"overflows (gate: zero in steady state)")
+    storm = {
+        "ops": storm_kw["ops"],
+        "acked": rd.acked,
+        "recovery_scan_dispatches": rd.counters["recovery_scan_dispatches"],
+        "recovery_scan_candidates":
+            rd.counters.get("recovery_scan_candidates", 0),
+        "recovery_scan_device_s":
+            round(rd.counters.get("recovery_scan_device_s", 0.0), 4),
+        "recovery_scan_host_s":
+            round(rh.counters.get("recovery_scan_host_s", 0.0), 4),
+        "fallbacks": 0,
+        "overflows": 0,
+        "history_identical": True,
+    }
+
+    # -- leg 1b: exec frontier staged into the megakernel -------------------
+    exec_kw = dict(ops=24 if quick else 40, nodes=4, rf=3, stores_per_node=2,
+                   key_count=24, concurrency=8, collect_log=True,
+                   exec_plane=True, exec_compact=True, megakernel=True)
+    e0, _ = run_mesh_burn(13, **exec_kw)
+    e1, _ = run_mesh_burn(13, exec_in_megakernel=True, **exec_kw)
+    if e0.log != e1.log:
+        raise AssertionError(
+            f"exec-in-megakernel burn diverged from the standalone "
+            f"compacted harvest ({len(e0.log)} vs {len(e1.log)} entries)")
+    if e1.counters["launches_per_tick"] != 1.0:
+        raise AssertionError(
+            f"exec traffic broke launch fusion: "
+            f"{e1.counters['launches_per_tick']:.2f} launches per tick "
+            f"(gate: exactly 1)")
+    if e1.counters.get("exec_scan_blocks", 0) <= 0 \
+            or e1.counters.get("exec_coord.staged_blocks", 0) <= 0:
+        raise AssertionError("no exec blocks rode the fused launches")
+    if e1.counters.get("exec_coord.compact_fallbacks", 0) \
+            or e1.counters.get("exec.compact_fallbacks", 0):
+        raise AssertionError("exec compact harvest degraded to the bitmask")
+
+    def _readback(r):
+        return (r.counters.get("exec.readback_bytes", 0)
+                + r.counters.get("exec_coord.readback_bytes", 0),
+                r.counters.get("exec.readback_full_equiv", 0)
+                + r.counters.get("exec_coord.readback_full_equiv", 0))
+
+    burn_rb, burn_full = _readback(e1)
+    exec_mk = {
+        "ops": exec_kw["ops"],
+        "acked": e1.acked,
+        "launches_per_tick": 1.0,
+        "exec_scan_blocks": e1.counters["exec_scan_blocks"],
+        "exec_flush_ticks": e1.counters.get("exec_flush_ticks", 0),
+        "staged_blocks": e1.counters["exec_coord.staged_blocks"],
+        "burn_readback_bytes": burn_rb,
+        "burn_readback_full_equiv": burn_full,
+        "history_identical": True,
+    }
+
+    # -- leg 2: compacted frontier readback at 10k in-flight ----------------
+    ecap, nplanes, per_plane = 2048, 5, 40
+    words = ecap // 32
+    frng = np.random.default_rng(23)
+    neg = np.int32(np.iinfo(np.int32).min)
+    planes, expected = [], []
+    for _ in range(nplanes):
+        rel = np.sort(frng.choice(np.arange(2, ecap), per_plane,
+                                  replace=False))
+        adj = np.zeros((ecap, ecap), bool)
+        # rows 0/1 gate each other; every other non-released row waits on
+        # row 0 (undecided executeAt: the commit-wait gates) -- ALL ecap
+        # rows stay pending (in flight), exactly `rel` clears its gates
+        adj[0, 1] = adj[1, 0] = True
+        gated = np.ones(ecap, bool)
+        gated[rel] = False
+        gated[:2] = False
+        adj[gated, 0] = True
+        planes.append((jnp.asarray(adj),
+                       jnp.full((ecap, 3), neg, jnp.int32),
+                       jnp.zeros(ecap, bool),       # applied
+                       jnp.ones(ecap, bool),        # pending: all in flight
+                       jnp.zeros(ecap, bool)))      # awaits_all
+        expected.append(rel.tolist())
+
+    class _FPlane:
+        def __init__(self):
+            self.calls = []
+
+        def _apply_rows(self, rows, gen):
+            self.calls.append((list(rows), gen))
+
+        def _apply_frontier(self, packed, gen):
+            raise AssertionError(
+                "10k-in-flight leg fell back to the bitmask decode")
+
+    class _FOwner:
+        readback_bytes = 0
+        readback_full_equiv = 0
+        compact_fallbacks = 0
+        compact_overflows = 0
+        _out_tiers = None
+
+        def _observe_bound(self, total):
+            pass
+
+    out_tiers = OutCapTiers(FRONTIER_OUT_TIERS, FRONTIER_OUT_TIERS[-1] * 2)
+    out_cap = out_tiers.pick(nplanes * per_plane)
+    res = frontier_compact(tuple(planes), out_cap=out_cap)
+    host = tuple(np.asarray(x) for x in res[:3])
+    if int(host[0][-1]) != nplanes * per_plane:
+        raise AssertionError(
+            f"frontier bound {int(host[0][-1])} != released "
+            f"{nplanes * per_plane}")
+    stubs = [_FPlane() for _ in range(nplanes)]
+    owner = _FOwner()
+    entries = [(p, (s * words, (s + 1) * words), 1)
+               for s, p in enumerate(stubs)]
+    _consume_compact(owner, res, host, entries, out_cap)
+    for s, p in enumerate(stubs):
+        if p.calls != [(expected[s], 1)]:
+            raise AssertionError(f"plane {s} release set diverged")
+    if owner.compact_fallbacks or owner.compact_overflows:
+        raise AssertionError("10k-in-flight compaction degraded")
+    if not owner.readback_bytes < owner.readback_full_equiv:
+        raise AssertionError(
+            f"compacted readback {owner.readback_bytes}B not strictly "
+            f"below the full-row equivalent {owner.readback_full_equiv}B "
+            f"at {nplanes * ecap} in-flight")
+
+    # -- leg 3: recovery scan at 10k in-flight, timed -----------------------
+    n = 2_048 if quick else 10_240
+    arena_cap = 16_384
+    chunk = 512
+    stall_ms = 1_000
+    ks = (0, 20, 40, 60)
+    iters = 6 if quick else 15
+
+    # recovery_tiers= pass-through (the warmup satellite): every rung the
+    # hysteresis picker can pin at this arena cap, floor included, plus
+    # the cmd-plane coverage the stream phase needs (already cached from
+    # bench_cmd_plane's own warmup -- process-global jit cache)
+    warmup(num_buckets=64, cap=1024, batch_tiers=(), scatter_tiers=(),
+           store_tiers=(1,), range_out_tiers=(), cmd_caps=(arena_cap,),
+           cmd_op_tiers=(chunk,), cmd_promote_modes=(True,),
+           recovery_tiers=RECOVERY_OUT_TIERS + (RECOVERY_OUT_TIERS[-1] * 2,))
+
+    cluster = Cluster(1, ClusterConfig(num_nodes=1, rf=1, num_shards=1,
+                                       stores_per_node=1, progress=False))
+    node = cluster.nodes[1]
+    store = node.command_stores.stores[0]
+    srng = _random.Random(7)
+    txns = []
+    for v in range(n):
+        keys = Keys(sorted(srng.sample(range(1, 257), srng.randint(1, 3))))
+        txn = Txn(TxnKind.WRITE, keys, read=ListRead(keys),
+                  update=ListUpdate(keys, v), query=ListQuery())
+        tid = node.next_txn_id(txn.kind, txn.domain)
+        txns.append((tid, node.compute_route(txn),
+                     txn.slice(store.ranges, include_query=False)))
+    plane = CmdPlane(store, initial_cap=arena_cap, key_cap=1024, kpad=4,
+                     apply_to_store=False)
+    eas = {}
+    for i in range(0, n, chunk):
+        span = txns[i:i + chunk]
+        res = plane.eval_batch([CmdOp.preaccept(t, p, r)
+                                for t, r, p in span])
+        for (tid, *_), r in zip(span, res):
+            eas[tid] = r.execute_at
+    # drive the last third to APPLIED: terminals the scan must skip
+    tail = txns[n - n // 3:]
+    for i in range(0, len(tail), chunk):
+        span = tail[i:i + chunk]
+        plane.eval_batch([CmdOp.commit(t, r, p, eas[t], Deps.NONE)
+                          for t, r, p in span])
+        plane.eval_batch([CmdOp.apply(t, r, p, eas[t], Deps.NONE)
+                          for t, r, p in span])
+
+    # synthetic stall ages (the storm burn above exercises the organic
+    # _touch path): ~9-15% of the live band stalls past each swept `now`
+    arng = np.random.default_rng(29)
+    now0 = int(node.now_millis()) + 100_000
+    plane.touched_h[:plane.n_rows] = \
+        now0 - arng.integers(0, 1_100, plane.n_rows, dtype=np.int32)
+    plane._touched_stale = True
+
+    st_h, th_h = plane.status_h, plane.touched_h
+
+    def py_walk(now):
+        # the pre-compaction host walk: per-txn python predicate over the
+        # whole live set, one dict/array probe each
+        out = []
+        for tid, row in plane.row_of.items():
+            s = int(st_h[row])
+            if CMD_ST_PRE_ACCEPTED <= s < CMD_ST_APPLIED \
+                    and now - int(th_h[row]) >= stall_ms:
+                out.append(tid)
+        return out
+
+    # organic warm sweep: same (now, stall) shapes as the timed window
+    for k in ks:
+        plane.recovery_scan_device(now0 + k, stall_ms)
+    cache0 = jit_cache_sizes()
+    d0 = plane.recovery_scan_dispatches
+    tdev0 = plane.recovery_scan_device_s
+    thost0 = plane.recovery_scan_host_s
+    fb0 = plane.recovery_scan_fallbacks
+    ov0 = plane.recovery_scan_overflows
+
+    walk_s = 0.0
+    totals = []
+    for _ in range(iters):
+        for k in ks:
+            now = now0 + k
+            dev = plane.recovery_scan_device(now, stall_ms)
+            twin = plane.recovery_scan_host(now, stall_ms)
+            t0 = time.perf_counter()
+            walked = py_walk(now)
+            walk_s += time.perf_counter() - t0
+            if dev != twin or dev != walked:
+                raise AssertionError(
+                    f"scan diverged at now+{k}: device {len(dev)} / twin "
+                    f"{len(twin)} / walk {len(walked)} candidates")
+            totals.append(len(dev))
+    cache1 = jit_cache_sizes()
+
+    if cache1 != cache0:
+        diff = {k: (cache0.get(k), cache1.get(k))
+                for k in set(cache0) | set(cache1)
+                if cache0.get(k) != cache1.get(k)}
+        raise AssertionError(
+            f"recovery scan window minted compiles: {diff}")
+    scans = iters * len(ks)
+    if plane.recovery_scan_dispatches - d0 != scans:
+        raise AssertionError(
+            f"{plane.recovery_scan_dispatches - d0} device dispatches for "
+            f"{scans} scans (gate: exactly one query per scan)")
+    if plane.recovery_scan_fallbacks - fb0 \
+            or plane.recovery_scan_overflows - ov0:
+        raise AssertionError("timed scans degraded to the host walk")
+    dev_s = plane.recovery_scan_device_s - tdev0
+    twin_s = plane.recovery_scan_host_s - thost0
+
+    return {
+        "storm": storm,
+        "exec_megakernel": exec_mk,
+        "exec_inflight": nplanes * ecap,
+        "exec_readback_bytes": owner.readback_bytes,
+        "exec_readback_full_equiv": owner.readback_full_equiv,
+        "scan": {
+            "inflight": n,
+            "arena_cap": arena_cap,
+            "scans": scans,
+            "candidates_min": min(totals),
+            "candidates_max": max(totals),
+            "python_walk_s": round(walk_s, 4),
+            "numpy_twin_s": round(twin_s, 4),
+            "device_s": round(dev_s, 4),
+            "walk_vs_device": round(walk_s / max(dev_s, 1e-9), 2),
+            "fallbacks": 0,                 # asserted above
+            "overflows": 0,                 # asserted above
+            "recompiles_in_window": 0,      # asserted above
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
 # 6. obs overhead: the disabled flight recorder must cost ~nothing
 # ---------------------------------------------------------------------------
 
@@ -2053,6 +2389,8 @@ def main(argv=None) -> int:
                                 args.quick)
         megakernel["messages_per_host_callback"] = \
             message_plane["messages_per_host_callback"]
+        recovery_storm = _traced("recovery_storm", bench_recovery_storm,
+                                 args.quick)
         # subprocess leg last: it runs in its OWN processes (each does its
         # own warmup), so the parent's jit caches and trace are untouched
         serve = bench_serve(args.quick)
@@ -2062,6 +2400,12 @@ def main(argv=None) -> int:
             "value": pipeline["device_block_us"],
             "unit": "us",
             "vs_baseline": pipeline["speedup_blocking"],
+            # compacted exec-frontier readback vs the full packed-bitmask
+            # fetch at 10k in-flight (compacted < full asserted in the
+            # recovery_storm leg)
+            "exec_readback_bytes": recovery_storm["exec_readback_bytes"],
+            "exec_readback_full_equiv":
+                recovery_storm["exec_readback_full_equiv"],
             "details": {
                 "device": device,
                 "warmup_s": round(warm_s, 1),
@@ -2077,6 +2421,7 @@ def main(argv=None) -> int:
                 "mesh_burn": mesh_burn,
                 "megakernel": megakernel,
                 "message_plane": message_plane,
+                "recovery_storm": recovery_storm,
                 "serve": serve,
                 "obs_overhead": obs_overhead,
             },

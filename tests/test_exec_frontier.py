@@ -1,0 +1,347 @@
+"""Device-compacted execution frontier + recovery scans (ops/kernels
+frontier_compact / recovery_scan, ops/exec_plane compacted harvests,
+ops/cmd_plane + impl/progress candidate scans).
+
+Tier-1 legs here are compile-free: numpy checksum twins, stub-store
+counter paths, the _consume_compact degradation contract driven with
+hand-built host lanes, and the progress-sweep filter over stub planes.
+Every leg that compiles a kernel or runs a burn is marked `slow` (the
+tier-1 suite sits ~2% under its timeout).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+
+# -- tier 1: compile-free units ---------------------------------------------
+
+def test_frontier_checksum_host_position_weighted():
+    """The host checksum twin must be order- and value-sensitive (a swap
+    or a bit flip in either lane changes the fold) and deterministic."""
+    from accord_tpu.ops.kernels import frontier_checksum_host
+
+    indptr = np.asarray([0, 3, 5], np.int32)
+    rows = np.asarray([1, 4, 9, 2, 7, 0, 0, 0], np.int32)
+    base = frontier_checksum_host(indptr, rows)
+    assert base == frontier_checksum_host(indptr.copy(), rows.copy())
+    swapped = rows.copy()
+    swapped[0], swapped[1] = swapped[1], swapped[0]
+    assert frontier_checksum_host(indptr, swapped) != base
+    bumped = rows.copy()
+    bumped[2] += 1
+    assert frontier_checksum_host(indptr, bumped) != base
+    assert frontier_checksum_host(
+        np.asarray([0, 2, 5], np.int32), rows) != base
+
+
+class _Sched:
+    def __init__(self):
+        self.calls = []
+
+    def once(self, delay_ms, fn):
+        self.calls.append((delay_ms, fn))
+
+
+class _Node:
+    def __init__(self):
+        self.scheduler = _Sched()
+        self.device_poll_ms = None
+
+
+class _Store:
+    def __init__(self):
+        self.node = _Node()
+
+    def command_if_present(self, txn_id):
+        return None
+
+
+def test_gen_drop_counts_and_reticks():
+    """A frontier harvested after compaction bumped the generation is
+    dropped, counted (exec.dropped_frontiers), and re-arms the tick --
+    previously the drop was silent."""
+    from accord_tpu.ops.exec_plane import ExecPlane
+
+    plane = ExecPlane(_Store(), initial_cap=64)
+    plane._gen = 3
+    plane._apply_rows([1, 2, 5], gen=2)
+    assert plane.dropped_frontiers == 1
+    assert plane.releases == 0
+    assert plane.store.node.scheduler.calls, "drop must re-arm the tick"
+    # the legacy bitmask path shares the same drop gate
+    plane._apply_frontier(np.zeros(2, np.uint32), gen=0)
+    assert plane.dropped_frontiers == 2
+
+
+class _RecPlane:
+    """Recording stand-in for an ExecPlane inside _consume_compact."""
+
+    def __init__(self):
+        self.rows_calls = []
+        self.frontier_calls = []
+
+    def _apply_rows(self, rows, gen):
+        self.rows_calls.append((list(rows), gen))
+
+    def _apply_frontier(self, packed, gen):
+        self.frontier_calls.append((np.asarray(packed).copy(), gen))
+
+
+class _Owner:
+    def __init__(self):
+        self.readback_bytes = 0
+        self.readback_full_equiv = 0
+        self.compact_fallbacks = 0
+        self.compact_overflows = 0
+        self.observed = []
+        self._out_tiers = None
+
+    def _observe_bound(self, total):
+        self.observed.append(total)
+
+
+def _two_plane_fixture():
+    """Two 64-row planes (2 u32 words each): plane0 releases rows {1, 40},
+    plane1 releases {3}. Returns (host lanes, packed bitmask, planes)."""
+    from accord_tpu.ops.kernels import frontier_checksum_host
+
+    packed = np.zeros(4, np.uint32)
+    packed[0] = 1 << 1          # plane0 row 1   (global bit 1)
+    packed[1] = 1 << 8          # plane0 row 40  (global bit 40)
+    packed[2] = 1 << 3          # plane1 row 3   (global bit 67)
+    indptr = np.asarray([0, 2, 3], np.int32)
+    rows = np.zeros(8, np.int32)
+    rows[:3] = (1, 40, 67)
+    csum = frontier_checksum_host(indptr, rows)
+    return (indptr, rows, csum), packed, (_RecPlane(), _RecPlane())
+
+
+def test_consume_compact_direct_slice():
+    """Good checksum, within cap: each plane gets its compaction segment
+    rebased to local rows; the retained bitmask is never fetched."""
+    from accord_tpu.ops.exec_plane import _consume_compact
+
+    host, packed, (p0, p1) = _two_plane_fixture()
+    owner = _Owner()
+    entries = [(p0, (0, 2), 7), (p1, (2, 4), 9)]
+    _consume_compact(owner, (None, None, None, packed), host, entries, 8)
+    assert p0.rows_calls == [([1, 40], 7)]
+    assert p1.rows_calls == [([3], 9)]
+    assert not p0.frontier_calls and not p1.frontier_calls
+    assert owner.readback_full_equiv == 4 * 4
+    assert owner.readback_bytes == host[0].nbytes + host[1].nbytes + 4
+    assert owner.observed == [3]
+    assert owner.compact_fallbacks == 0 and owner.compact_overflows == 0
+
+
+def test_consume_compact_checksum_fallback():
+    """A corrupt readback falls back to decoding the retained bitmask --
+    counted, and the release set is identical to the direct slice."""
+    from accord_tpu.ops.exec_plane import _consume_compact
+
+    host, packed, (p0, p1) = _two_plane_fixture()
+    indptr, rows, csum = host
+    bad = (indptr, rows, csum ^ 0x5A5A)
+    owner = _Owner()
+    entries = [(p0, (0, 2), 7), (p1, (2, 4), 9)]
+    _consume_compact(owner, (None, None, None, packed), bad, entries, 8)
+    assert owner.compact_fallbacks == 1
+    assert not p0.rows_calls and not p1.rows_calls
+    (pk0, g0), = p0.frontier_calls
+    (pk1, g1), = p1.frontier_calls
+    assert (g0, g1) == (7, 9)
+    # the spans decode to the same release set the direct slice carries
+    def decode(pk):
+        return np.nonzero(np.unpackbits(pk.view(np.uint8),
+                                        bitorder="little"))[0].tolist()
+    assert decode(pk0) == [1, 40]
+    assert decode(pk1) == [3]
+    # fallback pays the full-bitmask fetch on top of the compact lanes
+    assert owner.readback_bytes > host[0].nbytes + host[1].nbytes + 4
+
+
+def test_consume_compact_overflow_bumps_tier():
+    """indptr's bound is exact even past out_cap: the overflow is counted,
+    observed, and the tier ladder bumps for the next dispatch."""
+    from accord_tpu.ops.exec_plane import _consume_compact
+    from accord_tpu.ops.kernels import FRONTIER_OUT_TIERS
+    from accord_tpu.ops.tiers import OutCapTiers
+
+    host, packed, (p0, p1) = _two_plane_fixture()
+    owner = _Owner()
+    owner._out_tiers = OutCapTiers(FRONTIER_OUT_TIERS,
+                                   FRONTIER_OUT_TIERS[-1] * 2)
+    before = owner._out_tiers.pick(1)
+    entries = [(p0, (0, 2), 7), (p1, (2, 4), 9)]
+    _consume_compact(owner, (None, None, None, packed), host, entries, 2)
+    assert owner.compact_overflows == 1
+    assert owner.observed == [3]
+    assert p0.frontier_calls and p1.frontier_calls  # legacy decode served it
+    assert owner._out_tiers.pick(1) > before
+
+
+def test_recovery_scan_host_predicate_twin():
+    """CmdPlane.recovery_scan_host against a pure-python fold of the same
+    predicate: live status band (terminals above APPLIED excluded) and
+    stall age, candidates row-ascending."""
+    from accord_tpu.ops.cmd_plane import CmdPlane
+    from accord_tpu.ops.kernels import (CMD_ST_APPLIED,
+                                        CMD_ST_PRE_ACCEPTED)
+
+    plane = CmdPlane(_Store(), initial_cap=64, apply_to_store=False)
+    rng = np.random.default_rng(11)
+    n = 40
+    plane.n_rows = n
+    plane.status_h[:n] = rng.integers(0, 12, n)
+    plane.touched_h[:n] = rng.integers(0, 900, n)
+    tids = [f"t{i}" for i in range(n)]
+    plane.tid_by_row = list(tids)
+    plane.row_of = {t: i for i, t in enumerate(tids)}
+    now, stall = 1000, 300
+    expect = [tids[i] for i in range(n)
+              if CMD_ST_PRE_ACCEPTED <= plane.status_h[i] < CMD_ST_APPLIED
+              and now - plane.touched_h[i] >= stall]
+    assert plane.recovery_scan_host(now, stall) == expect
+    assert expect, "fixture must produce candidates"
+
+
+def test_sweep_waiters_scan_filter():
+    """Under a recovery-scan mode the sweep walks scan candidates still in
+    the live-waiter index, plus any waiter the arena has never seen."""
+    from accord_tpu.impl.progress import ProgressEngine
+
+    class _CmdPlaneStub:
+        row_of = {"a": 0, "b": 1, "c": 2}
+
+        def recovery_scan_host(self, now, stall):
+            return ["a", "b", "c"]
+
+    class _StoreStub:
+        cmd_plane = _CmdPlaneStub()
+        live_waiters = {"a", "c", "unrowed"}
+
+    class _NodeStub:
+        @staticmethod
+        def now_millis():
+            return 1000.0
+
+    eng = ProgressEngine(interval_ms=10.0, recovery_scan="host")
+    eng.node = _NodeStub()
+    got = eng._sweep_waiters(_StoreStub())
+    assert got == ["a", "c", "unrowed"]
+    # reference mode: the whole index, untouched
+    eng.recovery_scan = None
+    assert sorted(eng._sweep_waiters(_StoreStub())) == \
+        sorted(["a", "c", "unrowed"])
+
+
+# -- slow: compiled differentials -------------------------------------------
+
+@pytest.mark.slow
+def test_frontier_compact_matches_bitmask_randomized():
+    """Randomized compacted-vs-bitmask differential across plane counts
+    and out caps, including the overflow regime (indptr stays exact)."""
+    import jax.numpy as jnp
+    from accord_tpu.ops.kernels import (execution_frontier,
+                                        frontier_checksum_host,
+                                        frontier_compact)
+
+    rng = np.random.default_rng(23)
+    cap = 64
+    w = cap // 32
+
+    def rand_plane():
+        adj = rng.random((cap, cap)) < 0.06
+        np.fill_diagonal(adj, False)
+        ets = rng.integers(-5, 40, (cap, 3)).astype(np.int32)
+        ets[rng.random(cap) < 0.2] = np.iinfo(np.int32).min
+        return (jnp.asarray(adj), jnp.asarray(ets),
+                jnp.asarray(rng.random(cap) < 0.35),
+                jnp.asarray(rng.random(cap) < 0.6),
+                jnp.asarray(rng.random(cap) < 0.1))
+
+    for n_planes in (1, 2):
+        for trial in range(4):
+            planes = tuple(rand_plane() for _ in range(n_planes))
+            legacy = []
+            for pl in planes:
+                packed = np.asarray(execution_frontier(*pl))
+                legacy.append(np.nonzero(np.unpackbits(
+                    packed.view(np.uint8), bitorder="little"))[0])
+            total = sum(len(r) for r in legacy)
+            for out_cap in (4, 128):
+                indptr, rows, csum, pk = frontier_compact(
+                    planes, out_cap=out_cap)
+                indptr = np.asarray(indptr)
+                rows = np.asarray(rows)
+                assert int(indptr[-1]) == total  # exact even on overflow
+                assert frontier_checksum_host(indptr, rows) == \
+                    int(np.asarray(csum))
+                if total <= out_cap:
+                    for i, exp in enumerate(legacy):
+                        seg = rows[indptr[i]:indptr[i + 1]] - 32 * (i * w)
+                        assert seg.tolist() == exp.tolist(), \
+                            (n_planes, trial, out_cap, i)
+
+
+@pytest.mark.slow
+def test_recovery_scan_kernel_matches_host_twin():
+    """kernels.recovery_scan vs CmdPlane._stalled_mask over random arenas."""
+    import jax.numpy as jnp
+    from accord_tpu.ops.cmd_plane import CmdPlane
+    from accord_tpu.ops.kernels import (frontier_checksum_host,
+                                        recovery_scan)
+
+    rng = np.random.default_rng(31)
+    plane = CmdPlane(_Store(), initial_cap=128, apply_to_store=False)
+    for trial in range(4):
+        plane.status_h[:] = rng.integers(0, 12, plane.cap)
+        plane.touched_h[:] = rng.integers(0, 2000, plane.cap)
+        now, stall = 2500, 600
+        expect = np.nonzero(plane._stalled_mask(now, stall))[0]
+        indptr, rows, csum = recovery_scan(
+            jnp.asarray(plane.status_h), jnp.asarray(plane.touched_h),
+            np.int32(now), np.int32(stall), out_cap=plane.cap)
+        indptr, rows = np.asarray(indptr), np.asarray(rows)
+        assert frontier_checksum_host(indptr, rows) == \
+            int(np.asarray(csum))
+        assert rows[:int(indptr[-1])].tolist() == expect.tolist(), trial
+
+
+@pytest.mark.slow
+def test_exec_megakernel_bit_identical():
+    """Standalone compact coordinator vs exec-in-megakernel staging: same
+    histories, launches_per_tick == 1.0 with exec traffic included, and
+    the engine ledger shows exec blocks riding fused launches."""
+    from accord_tpu.sim.mesh_burn import run_mesh_burn
+
+    base = dict(ops=40, nodes=4, rf=3, stores_per_node=2, key_count=24,
+                concurrency=8, collect_log=True, exec_plane=True,
+                exec_compact=True)
+    r0, _ = run_mesh_burn(13, megakernel=True, **base)
+    r1, _ = run_mesh_burn(13, megakernel=True, exec_in_megakernel=True,
+                          **base)
+    assert r0.log == r1.log
+    assert r1.counters["launches_per_tick"] == 1.0
+    assert r1.counters["exec_scan_blocks"] > 0
+    assert r1.counters.get("exec_coord.staged_blocks", 0) > 0
+    assert r1.counters.get("exec_coord.compact_fallbacks", 0) == 0
+
+
+@pytest.mark.slow
+def test_recovery_scan_burn_device_matches_host():
+    """Crash-restart burn: device recovery scan commits bit-identical
+    histories to the host-scan baseline, with zero counted fallbacks."""
+    from accord_tpu.sim.mesh_burn import run_mesh_burn
+
+    base = dict(ops=40, nodes=4, rf=3, stores_per_node=2, key_count=24,
+                concurrency=8, collect_log=True, cmd_plane=True,
+                crash_restart=True)
+    rh, _ = run_mesh_burn(17, megakernel=True, recovery_scan="host", **base)
+    rd, _ = run_mesh_burn(17, megakernel=True, recovery_scan="device",
+                          **base)
+    assert rh.log == rd.log
+    assert rd.counters.get("recovery_scan_dispatches", 0) > 0
+    assert rd.counters.get("recovery_scan_fallbacks", 0) == 0
+    assert rd.counters.get("recovery_scan_overflows", 0) == 0
